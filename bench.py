@@ -1,0 +1,64 @@
+"""Benchmark entry point — prints ONE JSON line with the headline metric.
+
+Headline: single-chip sort throughput (keys/sec) on uniform random int32,
+compared against the reference system's measured end-to-end throughput of
+~4.4e4 keys/s total (BASELINE.md: 16,384 int32 in ~374 ms across 4 CPU
+workers over localhost TCP — its maximum supported job size).
+
+Env knobs: DSORT_BENCH_N (default 2^24 keys), DSORT_BENCH_REPS (default 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_KEYS_PER_SEC = 16_384 / 0.374  # BASELINE.md measured, ~4.38e4
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dsort_tpu.ops.local_sort import sort_keys
+
+    n = int(os.environ.get("DSORT_BENCH_N", 1 << 24))
+    reps = int(os.environ.get("DSORT_BENCH_REPS", 5))
+
+    rng = np.random.default_rng(0)
+    host = rng.integers(-(2**31), 2**31 - 1, n, dtype=np.int64).astype(np.int32)
+    x = jnp.asarray(host)
+
+    f = jax.jit(sort_keys)
+    y = f(x)
+    y.block_until_ready()  # compile + warm
+    # Sanity: correct against the numpy oracle on a sample window.
+    out = np.asarray(y)
+    assert (np.diff(out[: 1 << 16]) >= 0).all(), "bench output not sorted"
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    keys_per_sec = n / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": f"sort_throughput_int32_{n}_keys_single_chip",
+                "value": round(keys_per_sec, 1),
+                "unit": "keys/sec",
+                "vs_baseline": round(keys_per_sec / REFERENCE_KEYS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""dsort_tpu — TPU-native distributed sorting framework with fault tolerance.
+
+A from-scratch JAX / XLA / Pallas re-design of the capabilities of the reference
+C system ``khimansusinha/Distributed-sorting-with-fault-tolerance`` (master/worker
+merge sort over TCP sockets with reassign-on-failure; see /root/reference,
+``server.c`` / ``client.c``):
+
+- the worker's local recursive merge sort (``client.c:140-173``) becomes a
+  per-chip jitted sort (``ops.local_sort``);
+- the master's socket scatter + centralized O(N*k) merge (``server.c:342-456,
+  481-524``) becomes per-device sorts plus an on-mesh combine
+  (``models.pipelines``; the all_to_all sample-sort shuffle lands in
+  ``parallel.sample_sort``);
+- the fixed 4-worker TCP star (``server.c:120-157``) becomes a
+  ``jax.sharding.Mesh`` built from typed config (``config``, ``parallel.mesh``);
+- the reassign-on-failure scheduler (``server.c:297-477``) becomes a
+  liveness-tracking scheduler with heartbeats (fixing the reference's
+  hang-blindness), whole-shard retry on a live device, result-slot pinning,
+  and clean job failure when no devices remain (``scheduler`` package).
+
+Package layout (modules marked * are being landed incrementally this cycle):
+  models/    sort pipelines (the "model zoo": local, gather-merge, sample-sort*)
+  ops/       per-chip compute kernels (lax.sort wrappers; bitonic*, Pallas*)
+  parallel/  mesh construction + SPMD collectives (shard_map / all_to_all)
+  scheduler/ * job driver, liveness, fault tolerance, fault injection
+  data/      ingest/egress + synthetic generators (uniform, zipf, terasort)
+  runtime/   * native C++ runtime bindings (k-way merge, worker table, coordinator)
+  utils/     structured logging, metrics, tracing
+"""
+
+__version__ = "0.1.0"
+
+from dsort_tpu.config import (  # noqa: F401
+    JobConfig,
+    MeshConfig,
+    SortConfig,
+    load_conf_file,
+)

@@ -1,0 +1,165 @@
+"""Typed configuration for the framework (L5 of SURVEY.md's layer map).
+
+The reference configures itself from two ``KEY=value`` text files parsed with
+``strtok`` into header-defined globals (``server.c:61-90``, ``client.c:15-54``,
+``server.conf``, ``client.conf``).  Here the same idea becomes one typed,
+validated dataclass tree:
+
+- the reference's node list / port (``SERVER_IP``/``SERVER_PORT``) is
+  reinterpreted as a **device-mesh spec** (`MeshConfig`) — the cluster is a
+  ``jax.sharding.Mesh``, not a TCP star;
+- the reference's compile-time constants ``MAX_WORKERS=4``,
+  ``MAX_SUPPORTED_CHUNK_SIZE=4096`` (``server.c:11,13``) become runtime,
+  uncapped fields;
+- ``KEY=value`` files still parse (`load_conf_file`) for parity, including the
+  reference's exact keys, but unknown keys are reported instead of silently
+  aborting the parse (``server.c:78-84`` quirk not replicated).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+
+# Reference parity: server.conf:1 / client.conf:1-2 key names.
+_REFERENCE_KEYS = {"SERVER_IP", "SERVER_PORT"}
+
+
+class ConfigError(ValueError):
+    """Raised for invalid or inconsistent configuration."""
+
+
+def load_conf_file(path: str | os.PathLike) -> dict[str, str]:
+    """Parse a ``KEY=value`` conf file (reference ``read_conf_file`` parity).
+
+    Unlike ``server.c:61-90`` this accepts any key set, ignores blank lines and
+    ``#`` comments, strips whitespace, and raises a clear error for a missing
+    file instead of calling ``fclose(NULL)`` (``server.c:87``).
+    """
+    out: dict[str, str] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for lineno, raw in enumerate(f, 1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if "=" not in line:
+                    raise ConfigError(f"{path}:{lineno}: expected KEY=value, got {line!r}")
+                key, _, value = line.partition("=")
+                out[key.strip()] = value.strip()
+    except FileNotFoundError as e:
+        raise ConfigError(f"conf file not found: {path}") from e
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh spec — the TPU-native successor of the reference's node list.
+
+    The reference forms its "cluster" by blocking-accepting exactly 4 TCP
+    connections, identified by accept order (``server.c:148-157``).  Here the
+    cluster is a JAX device mesh: ``num_workers`` devices on the ``axis_name``
+    axis (optionally times a ``dp`` batch axis for independent jobs).
+    """
+
+    num_workers: int | None = None  # None → all visible devices
+    axis_name: str = "w"
+    dp: int = 1                     # independent-job (batch) axis size
+    dp_axis_name: str = "dp"
+
+    def __post_init__(self) -> None:
+        if self.num_workers is not None and self.num_workers < 1:
+            raise ConfigError(f"num_workers must be >= 1, got {self.num_workers}")
+        if self.dp < 1:
+            raise ConfigError(f"dp must be >= 1, got {self.dp}")
+        if self.axis_name == self.dp_axis_name:
+            raise ConfigError("axis_name and dp_axis_name must differ")
+
+
+@dataclasses.dataclass(frozen=True)
+class JobConfig:
+    """Per-job sort parameters.
+
+    Supersedes the reference's compile-time caps: workers (``server.c:11``),
+    chunk size (``server.c:13,193-196``), int32-only keys with ``-1`` reserved
+    as the wire sentinel (``server.c:405-406``).  Key dtype is configurable;
+    only the dtype's maximum value is reserved as padding sentinel, and only on
+    the key+payload path (documented in ``ops.local_sort``).
+    """
+
+    key_dtype: Any = jnp.int32
+    payload_bytes: int = 0          # 0 → key-only sort; >0 → TeraSort-style records
+    # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
+    oversample: int = 32            # splitter candidates per device
+    capacity_factor: float = 2.0    # per-(src,dst) all_to_all bucket headroom
+    max_capacity_retries: int = 3   # overflow → double capacity and retry
+    # Fault tolerance (reference semantics, SURVEY.md §5.3, + heartbeat upgrade):
+    max_reassign_attempts: int | None = None  # None → up to num_workers - 1
+    settle_delay_s: float = 0.1     # reference's 100 ms usleep (server.c:304,391,446)
+    heartbeat_timeout_s: float = 10.0  # fixes the reference's hang-blindness
+    checkpoint_dir: str | None = None  # persist sorted shards for partial recovery
+
+    def __post_init__(self) -> None:
+        import jax
+
+        if jnp.dtype(self.key_dtype).itemsize == 8 and not jax.config.jax_enable_x64:
+            raise ConfigError(
+                f"key_dtype {self.key_dtype} needs 64-bit mode: call "
+                "jax.config.update('jax_enable_x64', True) before building configs"
+            )
+        if self.payload_bytes < 0:
+            raise ConfigError(f"payload_bytes must be >= 0, got {self.payload_bytes}")
+        if self.oversample < 1:
+            raise ConfigError(f"oversample must be >= 1, got {self.oversample}")
+        if self.capacity_factor < 1.0:
+            raise ConfigError(f"capacity_factor must be >= 1.0, got {self.capacity_factor}")
+
+
+@dataclasses.dataclass(frozen=True)
+class SortConfig:
+    """Top-level framework config: mesh + job + control-plane endpoints."""
+
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    job: JobConfig = dataclasses.field(default_factory=JobConfig)
+    # Control-plane endpoint (native coordinator; reference server.conf parity).
+    server_ip: str = "127.0.0.1"
+    server_port: int = 9008        # reference default, server.conf:1
+    output_path: str = "output.txt"  # reference hardcodes this (server.c:517)
+
+    @classmethod
+    def from_mapping(cls, m: Mapping[str, str]) -> "SortConfig":
+        """Build from a flat KEY=value mapping (conf file or CLI overrides).
+
+        Accepts the reference's exact keys (``SERVER_IP``, ``SERVER_PORT``)
+        plus framework keys (``NUM_WORKERS``, ``KEY_DTYPE``, ``OVERSAMPLE``,
+        ``CAPACITY_FACTOR``, ``PAYLOAD_BYTES``, ``HEARTBEAT_TIMEOUT_S``,
+        ``OUTPUT_PATH``, ``DP``).
+        """
+        def geti(key: str, default: int | None) -> int | None:
+            return int(m[key]) if key in m else default
+
+        mesh = MeshConfig(
+            num_workers=geti("NUM_WORKERS", None),
+            dp=geti("DP", 1),
+        )
+        job = JobConfig(
+            key_dtype=jnp.dtype(m.get("KEY_DTYPE", "int32")),
+            payload_bytes=geti("PAYLOAD_BYTES", 0),
+            oversample=geti("OVERSAMPLE", 32),
+            capacity_factor=float(m.get("CAPACITY_FACTOR", 2.0)),
+            heartbeat_timeout_s=float(m.get("HEARTBEAT_TIMEOUT_S", 10.0)),
+        )
+        return cls(
+            mesh=mesh,
+            job=job,
+            server_ip=m.get("SERVER_IP", "127.0.0.1"),
+            server_port=int(m.get("SERVER_PORT", 9008)),
+            output_path=m.get("OUTPUT_PATH", "output.txt"),
+        )
+
+    @classmethod
+    def from_conf_file(cls, path: str | os.PathLike) -> "SortConfig":
+        return cls.from_mapping(load_conf_file(path))

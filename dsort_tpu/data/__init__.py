@@ -1,0 +1,4 @@
+"""Data plane: ingest/egress and synthetic workload generators."""
+
+from dsort_tpu.data.ingest import read_ints_file, write_ints_file  # noqa: F401
+from dsort_tpu.data.partition import equal_partition, pad_to_shards  # noqa: F401

@@ -1,0 +1,59 @@
+"""Ingest / egress + synthetic generators (part of L4, SURVEY.md §2.1).
+
+The reference ingests by a two-pass ``fscanf`` loop over an ASCII file of one
+int per line (``server.c:171-182``) and egresses one ``fprintf`` per int to a
+hardcoded ``output.txt`` (``server.c:517-519``).  Equivalents here use numpy
+bulk IO (single pass), plus the generator family for the BASELINE.json
+benchmark configs: uniform random (config #2/#3), Zipf-skewed (config #5), and
+TeraSort-style 100-byte records (config #4).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def read_ints_file(path: str | os.PathLike, dtype=np.int32) -> np.ndarray:
+    """Read an ASCII one-int-per-line file (reference input.txt format)."""
+    return np.loadtxt(path, dtype=dtype, ndmin=1)
+
+
+def write_ints_file(path: str | os.PathLike, data: np.ndarray) -> None:
+    """Write one int per line (byte-compatible with reference output.txt)."""
+    np.savetxt(path, np.asarray(data).reshape(-1), fmt="%d")
+
+
+def gen_uniform(n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:
+    """Uniform random keys over the dtype's full range (BASELINE config #2/#3)."""
+    rng = np.random.default_rng(seed)
+    dtype = np.dtype(dtype)
+    info = np.iinfo(dtype)
+    return rng.integers(info.min, info.max, size=n, dtype=dtype, endpoint=False)
+
+
+def gen_zipf(n: int, a: float = 1.3, dtype=np.int64, seed: int = 0) -> np.ndarray:
+    """Zipf-skewed keys (BASELINE config #5) — stresses splitter balance."""
+    rng = np.random.default_rng(seed)
+    return rng.zipf(a, size=n).astype(dtype)
+
+
+def gen_terasort(
+    n: int, key_bytes: int = 10, payload_bytes: int = 90, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """TeraSort-style records (BASELINE config #4).
+
+    Returns ``(keys, payloads)``: keys are the first 8 bytes of the 10-byte
+    key interpreted big-endian as uint64 (sorting by this 8-byte prefix is
+    byte-order-equivalent for random data; full 10-byte tie-breaking is done
+    by carrying the remaining bytes in the payload), payloads are
+    ``(n, key_bytes - 8 + payload_bytes)`` uint8.
+    """
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(0, 256, size=(n, key_bytes + payload_bytes), dtype=np.uint8)
+    keys = raw[:, :8].astype(np.uint64)
+    packed = np.zeros(n, dtype=np.uint64)
+    for b in range(8):
+        packed = (packed << np.uint64(8)) | keys[:, b]
+    return packed, raw[:, 8:]

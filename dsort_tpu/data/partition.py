@@ -1,0 +1,68 @@
+"""Partitioning: split a key array into per-worker shards (L4).
+
+The reference partitions into ``MAX_WORKERS`` equal chunks with the remainder
+spread one extra element each over the first ``total % MAX_WORKERS`` workers,
+and aborts above 4,096 ints per chunk (``server.c:185-216``).
+`equal_partition` keeps exactly those remainder semantics, uncapped;
+`pad_to_shards` produces the static-shape ``(W, cap)`` layout + counts that the
+SPMD phases require.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dsort_tpu.ops.local_sort import sentinel_for
+
+
+def equal_partition(total: int, num_workers: int) -> list[int]:
+    """Chunk sizes per worker — reference remainder semantics (server.c:185-196)."""
+    if num_workers < 1:
+        raise ValueError("num_workers must be >= 1")
+    base, rem = divmod(total, num_workers)
+    return [base + (1 if i < rem else 0) for i in range(num_workers)]
+
+
+def partition(data: np.ndarray, num_workers: int) -> list[np.ndarray]:
+    """Split ``data`` into contiguous chunks per `equal_partition` sizes."""
+    sizes = equal_partition(len(data), num_workers)
+    out, off = [], 0
+    for s in sizes:
+        out.append(data[off : off + s])
+        off += s
+    return out
+
+
+def pad_to_shards(
+    data: np.ndarray, num_workers: int, multiple: int = 8
+) -> tuple[np.ndarray, np.ndarray]:
+    """Lay ``data`` out as ``(num_workers, cap)`` + per-shard valid counts.
+
+    ``cap`` is the max chunk size rounded up to ``multiple`` (TPU-friendly
+    alignment); pads hold the dtype sentinel.  This is the static-shape
+    successor of the reference's malloc'd variable chunks (``server.c:206-216``).
+    """
+    sizes = equal_partition(len(data), num_workers)
+    cap = -(-max(sizes + [1]) // multiple) * multiple
+    out = np.full((num_workers, cap), sentinel_for(data.dtype), dtype=data.dtype)
+    off = 0
+    for i, s in enumerate(sizes):
+        out[i, :s] = data[off : off + s]
+        off += s
+    return out, np.asarray(sizes, dtype=np.int32)
+
+
+def pad_kv_to_shards(
+    keys: np.ndarray, payload: np.ndarray, num_workers: int, multiple: int = 8
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Key+payload variant of `pad_to_shards`; payload pads are zeros."""
+    sizes = equal_partition(len(keys), num_workers)
+    cap = -(-max(sizes + [1]) // multiple) * multiple
+    out_k = np.full((num_workers, cap), sentinel_for(keys.dtype), dtype=keys.dtype)
+    out_v = np.zeros((num_workers, cap) + payload.shape[1:], dtype=payload.dtype)
+    off = 0
+    for i, s in enumerate(sizes):
+        out_k[i, :s] = keys[off : off + s]
+        out_v[i, :s] = payload[off : off + s]
+        off += s
+    return out_k, out_v, np.asarray(sizes, dtype=np.int32)

@@ -1,0 +1,19 @@
+"""Sort pipelines — the framework's "model zoo".
+
+Each pipeline is a full partition→sort→combine strategy with the same
+correctness contract as the reference job loop (``server.c:160-268``):
+output is a total ascending order of the input.
+
+- ``local``: single-chip tiled sort + merge (flagship jittable step).
+- ``gather_merge``: per-device local sort + host k-way merge — the direct
+  TPU analogue of the reference's scatter/sort/central-merge design.
+- ``sample_sort`` (in ``parallel.sample_sort``): splitter-based all_to_all
+  shuffle + per-chip merge — the scalable path that removes the central merge
+  (SURVEY.md §5.7).
+"""
+
+from dsort_tpu.models.pipelines import (  # noqa: F401
+    GatherMergeSort,
+    local_pipeline,
+    local_pipeline_step,
+)

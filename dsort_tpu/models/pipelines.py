@@ -1,0 +1,85 @@
+"""End-to-end sort pipelines (L4 job driver over L0-L2 primitives).
+
+`GatherMergeSort` mirrors the reference's architecture — partition
+(``server.c:185-216``), parallel per-worker sort (``client.c:140-173``),
+centralized merge (``server.c:481-524``) — but each "worker" is a mesh device
+running a jitted sort, and the merge is O(N log k) on host (or fully on-device
+when the data fits one chip).  `parallel.sample_sort.SampleSort` supersedes it
+at scale.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsort_tpu.data.partition import pad_to_shards
+from dsort_tpu.ops.local_sort import sort_padded
+from dsort_tpu.ops.merge import merge_shards_device, merge_sorted_host
+from dsort_tpu.utils.metrics import Metrics, PhaseTimer
+
+
+def local_pipeline(shards: jax.Array, counts: jax.Array):
+    """Flagship single-chip step: row-wise padded sort + on-device merge.
+
+    ``shards``: (W, cap) keys with pads at arbitrary positions >= counts[w];
+    returns ``(sorted_flat, total_count)`` with pads parked at the tail.
+    This is the whole reference job (partition→sort→merge, ``server.c:160-268``)
+    as one fused XLA computation.
+    """
+    sorted_shards, counts = jax.vmap(sort_padded)(shards, counts)
+    return merge_shards_device(sorted_shards, counts)
+
+
+local_pipeline_step = jax.jit(local_pipeline)
+
+
+class GatherMergeSort:
+    """Per-device local sort + gather + host merge (BASELINE config #2).
+
+    The reference analogue: scatter chunks to workers over TCP, sort remotely,
+    gather, merge centrally.  Here scatter/gather are device transfers and the
+    remote sort is a ``shard_map``'d ``lax.sort`` over the worker mesh axis.
+    """
+
+    def __init__(self, mesh: Mesh, axis_name: str = "w"):
+        self.mesh = mesh
+        self.axis = axis_name
+        self.num_workers = mesh.shape[axis_name]
+
+        @functools.partial(jax.jit, out_shardings=None)
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(axis_name, None), P(axis_name)),
+            out_specs=(P(axis_name, None), P(axis_name)),
+        )
+        def _sort_shards(shards, counts):
+            # shards: (1, cap) per device; counts: (1,) per device.
+            return jax.vmap(sort_padded)(shards, counts)
+
+        self._sort_shards = _sort_shards
+
+    def sort(self, data: np.ndarray, metrics: Metrics | None = None) -> np.ndarray:
+        metrics = metrics if metrics is not None else Metrics()
+        timer = PhaseTimer(metrics)
+        with timer.phase("partition"):
+            shards, counts = pad_to_shards(data, self.num_workers)
+            sharding = NamedSharding(self.mesh, P(self.axis, None))
+            csharding = NamedSharding(self.mesh, P(self.axis))
+            shards = jax.device_put(jnp.asarray(shards), sharding)
+            counts = jax.device_put(jnp.asarray(counts), csharding)
+        with timer.phase("local_sort"):
+            sorted_shards, counts = self._sort_shards(shards, counts)
+            sorted_shards.block_until_ready()
+        with timer.phase("gather"):
+            host_shards = np.asarray(sorted_shards)
+            host_counts = np.asarray(counts)
+        with timer.phase("merge"):
+            runs = [host_shards[i, : host_counts[i]] for i in range(self.num_workers)]
+            out = merge_sorted_host(runs)
+        return out

@@ -1,0 +1,8 @@
+"""Per-chip compute kernels: lax.sort wrappers, merges, bitonic/Pallas sorts."""
+
+from dsort_tpu.ops.local_sort import (  # noqa: F401
+    sentinel_for,
+    sort_keys,
+    sort_kv,
+    sort_padded,
+)

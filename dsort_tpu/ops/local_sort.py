@@ -1,0 +1,98 @@
+"""Per-chip local sort kernels (L0 of SURVEY.md's layer map).
+
+The reference's compute kernel is a recursive top-down merge sort running on a
+worker's CPU with per-merge mallocs (``client.c:140-173``), limited to 4,096
+int32 keys (``client.c:10,91``).  The TPU-native kernel is ``jax.lax.sort``
+under ``jit`` — XLA lowers it to a tuned on-chip sort — with Pallas/bitonic
+variants in ``ops.bitonic`` / ``ops.pallas_sort``.  No recursion, no dynamic
+shapes, no size cap beyond HBM.
+
+Padding convention (static shapes): distributed phases carry fixed-size
+buffers plus a valid-element count.  Pads hold ``sentinel_for(dtype)`` (the
+dtype's maximum) so an ascending sort parks them at the tail and trimming by
+count recovers the valid data.  For key-only sorts this is exact even when
+real keys equal the sentinel (equal keys are indistinguishable).  For
+key+payload sorts, pad entries are additionally forced *after* all real
+entries by a secondary is-pad sort key, so no key value is reserved — unlike
+the reference, which reserves ``-1`` on its wire for every job
+(``server.c:405-406``, ``client.c:113``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sentinel_for(dtype) -> jnp.ndarray:
+    """Largest representable value of ``dtype`` — the padding sentinel."""
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype=dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype=dtype)
+
+
+def sort_keys(keys: jax.Array) -> jax.Array:
+    """Ascending sort of a 1-D (or batched last-axis) key array."""
+    return jnp.sort(keys, axis=-1)
+
+
+def _apply_perm(payload: jax.Array, perm: jax.Array, axis: int) -> jax.Array:
+    """Apply a per-slice sort permutation to a payload with trailing dims."""
+    idx = perm.reshape(perm.shape + (1,) * (payload.ndim - perm.ndim))
+    return jnp.take_along_axis(payload, idx, axis=axis)
+
+
+def sort_kv(keys: jax.Array, payload: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Sort ``keys`` ascending, permuting ``payload`` rows along with them.
+
+    ``payload`` has shape ``keys.shape + (...,)`` — e.g. TeraSort's 90-byte
+    values as ``(n, 90)`` uint8.  Uses ``lax.sort``'s multi-operand form, so
+    the permutation is applied on-chip in one fused op.
+    """
+    if payload.ndim == keys.ndim:
+        out_k, out_v = jax.lax.sort((keys, payload), dimension=-1, num_keys=1)
+        return out_k, out_v
+    # lax.sort wants equal-shaped operands; sort an index permutation instead.
+    idx = jnp.broadcast_to(
+        jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1), keys.shape
+    )
+    out_k, perm = jax.lax.sort((keys, idx), dimension=-1, num_keys=1)
+    return out_k, _apply_perm(payload, perm, keys.ndim - 1)
+
+
+def sort_padded(
+    keys: jax.Array, count: jax.Array | int
+) -> tuple[jax.Array, jax.Array]:
+    """Sort a fixed-size buffer whose first ``count`` entries are valid.
+
+    Entries at positions >= ``count`` are overwritten with the sentinel before
+    sorting, so the result is ``(sorted buffer with pads at the tail, count)``.
+    """
+    n = keys.shape[-1]
+    pos = jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1)
+    masked = jnp.where(pos < count, keys, sentinel_for(keys.dtype))
+    return jnp.sort(masked, axis=-1), jnp.asarray(count, jnp.int32)
+
+
+def sort_kv_padded(
+    keys: jax.Array, payload: jax.Array, count: jax.Array | int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Key+payload variant of `sort_padded`, reserving no key value.
+
+    Sorts lexicographically by ``(key, is_pad)`` so real entries whose key
+    equals the sentinel still sort ahead of pads and keep their payloads.
+    """
+    pos = jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1)
+    is_pad = (pos >= count).astype(jnp.int8)
+    masked = jnp.where(pos < count, keys, sentinel_for(keys.dtype))
+    if payload.ndim == keys.ndim:
+        out_k, _, out_v = jax.lax.sort(
+            (masked, is_pad, payload), dimension=-1, num_keys=2
+        )
+        return out_k, out_v, jnp.asarray(count, jnp.int32)
+    idx = jnp.broadcast_to(
+        jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1), keys.shape
+    )
+    out_k, _, perm = jax.lax.sort((masked, is_pad, idx), dimension=-1, num_keys=2)
+    return out_k, _apply_perm(payload, perm, keys.ndim - 1), jnp.asarray(count, jnp.int32)

@@ -1,0 +1,74 @@
+"""Merging sorted runs (the reference's ``merge_chunks`` role, L4).
+
+The reference's global combine is a centralized, single-threaded k-way merge
+on the master using a repeated linear min-scan — O(N*k) — straight into
+``fprintf`` (``server.c:481-524``); SURVEY.md §5.7 flags it as the scalability
+bottleneck.  Replacements, in increasing preference:
+
+- `merge_sorted_host`: O(N log k) heap merge on the host via numpy/heapq, with
+  an optional native C++ fast path (``runtime.native``) — used by the
+  gather-merge pipeline and as the final egress assembler.
+- `merge_shards_device`: on-device merge of W already-sorted equal-length runs
+  by re-sorting the concatenation with ``lax.sort`` (XLA's sort is O(N log N)
+  but runs at chip speed and fuses; for the shard sizes that reach a single
+  chip this beats host round-trips by orders of magnitude).
+- the sample-sort path (``parallel.sample_sort``) removes the global merge
+  entirely: after the all_to_all every chip owns a disjoint key range.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def merge_sorted_host(chunks: list[np.ndarray]) -> np.ndarray:
+    """Heap-based k-way merge of sorted host arrays (O(N log k)).
+
+    Delegates to the native C++ merge when the runtime library is built;
+    falls back to a numpy two-way reduction (still O(N log k) overall).
+    """
+    dtype = np.asarray(chunks[0]).dtype if chunks else np.int32
+    chunks = [np.asarray(c) for c in chunks if len(c)]
+    if not chunks:
+        return np.empty(0, dtype=dtype)
+    try:
+        from dsort_tpu.runtime import native
+
+        if native.available() and native.supports_dtype(chunks[0].dtype):
+            return native.kway_merge(chunks)
+    except ImportError:
+        pass
+    # Pairwise two-way merges, log2(k) rounds — numpy-vectorized via sort of
+    # pairs is slower than true merge; use heapq.merge streaming instead only
+    # for tiny inputs, else pairwise np concatenate+mergesort (timsort's
+    # galloping makes concat-of-sorted near-linear).
+    runs = chunks
+    while len(runs) > 1:
+        nxt = []
+        for i in range(0, len(runs) - 1, 2):
+            merged = np.concatenate([runs[i], runs[i + 1]])
+            merged.sort(kind="stable")  # timsort: near-linear on 2 sorted runs
+            nxt.append(merged)
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return runs[0]
+
+
+def merge_sorted_host_streaming(chunks: list[np.ndarray]):
+    """Generator form (true heapq k-way) for bounded-memory egress."""
+    return heapq.merge(*[iter(c) for c in chunks])
+
+
+def merge_shards_device(shards: jax.Array, counts: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Merge ``(W, cap)`` sorted padded runs into one ``(W*cap,)`` sorted run.
+
+    Pads (dtype sentinel) already sit at each run's tail, so a flat re-sort
+    leaves all valid data in the prefix of length ``sum(counts)``.
+    """
+    flat = shards.reshape(-1)
+    return jnp.sort(flat), jnp.sum(counts).astype(jnp.int32)

@@ -1,0 +1,3 @@
+"""SPMD parallelism: mesh construction and collective sort algorithms."""
+
+from dsort_tpu.parallel.mesh import make_mesh, local_device_mesh  # noqa: F401

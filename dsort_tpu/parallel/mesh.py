@@ -1,0 +1,70 @@
+"""Device-mesh construction (L1 cluster membership, TPU-native).
+
+The reference forms its cluster by accepting exactly ``MAX_WORKERS=4`` TCP
+connections and identifying workers by accept order (``server.c:120-157``);
+membership is fixed for the process lifetime and a dead worker can never
+rejoin (SURVEY.md §5.3).  Here the cluster is a ``jax.sharding.Mesh`` over the
+visible devices; "membership" is the device list, and recovery re-forms the
+mesh over live devices (``scheduler``), which the reference cannot do.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from dsort_tpu.config import ConfigError, MeshConfig
+
+
+def force_cpu_devices(n: int) -> None:
+    """Best-effort switch to ``n`` simulated CPU devices (tests / dry runs).
+
+    Must run before JAX initializes a backend.  Works both when jax is freshly
+    imported (env vars) and when a site hook pre-imported jax (config.update).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; caller must check device count
+
+
+def make_mesh(
+    cfg: MeshConfig,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the framework's device mesh from config.
+
+    Shape is ``(dp, num_workers)`` with axis names ``(dp_axis_name,
+    axis_name)``; ``dp=1`` (the default) gives the plain 1×W sort mesh.  The
+    worker axis is the successor of the reference's 4-socket star: each index
+    along it plays the role of one ``client_sockets[i]`` slot
+    (``server.c:17``), except the size is the real device count, not a
+    compile-time 4.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    w = cfg.num_workers if cfg.num_workers is not None else len(devs) // cfg.dp
+    need = w * cfg.dp
+    if w < 1 or need > len(devs):
+        raise ConfigError(
+            f"mesh needs {need} devices (dp={cfg.dp} x workers={w}), "
+            f"but only {len(devs)} visible"
+        )
+    import numpy as np
+
+    grid = np.array(devs[:need]).reshape(cfg.dp, w)
+    return Mesh(grid, (cfg.dp_axis_name, cfg.axis_name))
+
+
+def local_device_mesh(n: int | None = None, axis_name: str = "w") -> Mesh:
+    """Convenience 1-D mesh over the first ``n`` (default: all) local devices."""
+    cfg = MeshConfig(num_workers=n, axis_name=axis_name)
+    return make_mesh(cfg)

@@ -1,0 +1,4 @@
+"""Cross-cutting utilities: structured logging, metrics, tracing."""
+
+from dsort_tpu.utils.logging import get_logger  # noqa: F401
+from dsort_tpu.utils.metrics import PhaseTimer, Metrics  # noqa: F401

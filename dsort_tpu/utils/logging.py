@@ -1,0 +1,31 @@
+"""Leveled structured logging (SURVEY.md §5.1/§5.5 upgrade).
+
+The reference's only observability is unconditional ``printf`` of protocol
+steps *and full chunk contents* (``server.c:314-318,460-463``,
+``client.c:106-109,120-123``) — measured in BASELINE.md to dominate wall time.
+Here: standard ``logging`` with levels, a compact structured formatter, and no
+O(N) data dumps anywhere on the hot path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(levelname).1s %(name)s: %(message)s"
+_DATEFMT = "%H:%M:%S"
+_configured = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _configured
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+        root = logging.getLogger("dsort_tpu")
+        root.addHandler(handler)
+        root.setLevel(os.environ.get("DSORT_LOG_LEVEL", "INFO").upper())
+        root.propagate = False
+        _configured = True
+    return logging.getLogger(f"dsort_tpu.{name}")

@@ -1,0 +1,64 @@
+"""Per-phase timers and throughput metrics (SURVEY.md §5.5 upgrade).
+
+The reference has no timers at all — not even elapsed time per job.  This
+module provides the phase breakdown (ingest / partition / local sort /
+shuffle / merge / egress) and the north-star keys/sec/chip metric from
+BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from collections import defaultdict
+
+
+@dataclasses.dataclass
+class Metrics:
+    """Accumulated per-phase wall times and counters for one job."""
+
+    phase_s: dict[str, float] = dataclasses.field(
+        default_factory=lambda: defaultdict(float)
+    )
+    counters: dict[str, int] = dataclasses.field(
+        default_factory=lambda: defaultdict(int)
+    )
+
+    def add(self, phase: str, seconds: float) -> None:
+        self.phase_s[phase] += seconds
+
+    def bump(self, counter: str, by: int = 1) -> None:
+        self.counters[counter] += by
+
+    def total_s(self) -> float:
+        return sum(self.phase_s.values())
+
+    def keys_per_sec(self, n_keys: int) -> float:
+        t = self.total_s()
+        return n_keys / t if t > 0 else float("inf")
+
+    def keys_per_sec_per_chip(self, n_keys: int, n_chips: int) -> float:
+        return self.keys_per_sec(n_keys) / max(n_chips, 1)
+
+    def summary(self) -> dict:
+        return {
+            "phases_ms": {k: round(v * 1e3, 3) for k, v in self.phase_s.items()},
+            "counters": dict(self.counters),
+            "total_ms": round(self.total_s() * 1e3, 3),
+        }
+
+
+class PhaseTimer:
+    """Context-manager timer feeding a `Metrics` object."""
+
+    def __init__(self, metrics: Metrics):
+        self.metrics = metrics
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.metrics.add(name, time.perf_counter() - t0)

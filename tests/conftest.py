@@ -1,0 +1,39 @@
+"""Test harness: force an 8-device simulated CPU mesh (SURVEY.md §4).
+
+The reference has no tests at all; its de-facto strategy is a golden
+input/output pair plus manual multi-process runs (SURVEY.md §4).  Here the
+"cluster" for tests is JAX's CPU multi-device simulation, so distributed
+behavior (shard_map, all_to_all, fault reassignment) runs in-process.
+
+Note: this environment may pre-import jax via a site hook with a TPU platform
+pinned in ``JAX_PLATFORMS``; env vars alone are then too late, so we also use
+``jax.config.update`` before any backend is initialized.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # 64-bit key dtypes (BASELINE config #3)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 simulated CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from dsort_tpu.parallel.mesh import local_device_mesh
+
+    return local_device_mesh(8)

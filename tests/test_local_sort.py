@@ -1,0 +1,104 @@
+"""L0 kernel tests — oracle is np.sort (SURVEY.md §4's property-test plan)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsort_tpu.ops.local_sort import (
+    sentinel_for,
+    sort_keys,
+    sort_kv,
+    sort_kv_padded,
+    sort_padded,
+)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint32, np.float32])
+def test_sort_keys_matches_numpy(dtype):
+    rng = np.random.default_rng(1)
+    if np.issubdtype(dtype, np.floating):
+        x = rng.standard_normal(1000).astype(dtype)
+    else:
+        info = np.iinfo(dtype)
+        x = rng.integers(info.min, info.max, 1000, dtype=dtype)
+    np.testing.assert_array_equal(np.asarray(sort_keys(jnp.asarray(x))), np.sort(x))
+
+
+def test_sort_keys_negative_and_minus_one():
+    # The reference reserves -1 on its wire (server.c:405-406); we must sort it.
+    x = np.array([5, -1, 3, -1, -7], dtype=np.int32)
+    np.testing.assert_array_equal(np.asarray(sort_keys(jnp.asarray(x))), np.sort(x))
+
+
+def test_sort_kv_permutes_payload():
+    keys = np.array([3, 1, 2], dtype=np.int32)
+    vals = np.array([30, 10, 20], dtype=np.int32)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals))
+    np.testing.assert_array_equal(np.asarray(k), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(v), [10, 20, 30])
+
+
+def test_sort_kv_2d_payload():
+    keys = np.array([3, 1, 2], dtype=np.int64)
+    vals = np.arange(12, dtype=np.uint8).reshape(3, 4)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals))
+    np.testing.assert_array_equal(np.asarray(k), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(v), vals[[1, 2, 0]])
+
+
+def test_sort_padded_trims_correctly():
+    buf = np.array([5, 2, 9, 777, 888], dtype=np.int32)  # last 2 are garbage
+    out, count = sort_padded(jnp.asarray(buf), 3)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[:3], [2, 5, 9])
+    assert (out[3:] == sentinel_for(np.int32)).all()
+    assert int(count) == 3
+
+
+def test_sort_padded_keys_equal_to_sentinel():
+    # Key-only: real INT32_MAX keys may interleave with pads; count-trim is
+    # still an exact multiset (equal keys are indistinguishable).
+    m = np.iinfo(np.int32).max
+    buf = np.array([m, 1, m, 0, 12345], dtype=np.int32)
+    out, count = sort_padded(jnp.asarray(buf), 4)
+    np.testing.assert_array_equal(np.asarray(out)[:4], np.sort(buf[:4]))
+
+
+def test_sort_kv_padded_no_reserved_key():
+    # KV: even keys equal to the sentinel keep their payloads ahead of pads —
+    # strictly better than the reference's reserved -1 (client.c:113).
+    m = np.iinfo(np.int32).max
+    keys = np.array([m, 1, 7, 999], dtype=np.int32)  # last is garbage
+    vals = np.array([111, 222, 333, 0], dtype=np.int32)
+    k, v, count = sort_kv_padded(jnp.asarray(keys), jnp.asarray(vals), 3)
+    k, v = np.asarray(k), np.asarray(v)
+    np.testing.assert_array_equal(k[:3], [1, 7, m])
+    np.testing.assert_array_equal(v[:3], [222, 333, 111])
+    assert int(count) == 3
+
+
+def test_sort_padded_batched():
+    rng = np.random.default_rng(2)
+    buf = rng.integers(-1000, 1000, (4, 16)).astype(np.int32)
+    counts = np.array([16, 0, 5, 10], dtype=np.int32)
+    import jax
+
+    out, _ = jax.vmap(sort_padded)(jnp.asarray(buf), jnp.asarray(counts))
+    out = np.asarray(out)
+    for i, c in enumerate(counts):
+        np.testing.assert_array_equal(out[i, :c], np.sort(buf[i, :c]))
+        assert (out[i, c:] == sentinel_for(np.int32)).all()
+
+
+def test_sort_kv_batched_payload():
+    # Regression: batched keys + trailing-dim payload must permute per-row
+    # (take_along_axis semantics), not fan out globally.
+    rng = np.random.default_rng(8)
+    keys = rng.integers(0, 100, (2, 5)).astype(np.int32)
+    vals = rng.integers(0, 255, (2, 5, 3)).astype(np.uint8)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals))
+    assert np.asarray(v).shape == (2, 5, 3)
+    for b in range(2):
+        order = np.argsort(keys[b], kind="stable")
+        np.testing.assert_array_equal(np.asarray(k)[b], keys[b][order])
+        np.testing.assert_array_equal(np.asarray(v)[b], vals[b][order])

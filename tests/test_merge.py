@@ -1,0 +1,49 @@
+"""Merge tests — replaces the reference's O(N*k) central merge (server.c:481-524)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dsort_tpu.ops.local_sort import sentinel_for, sort_padded
+from dsort_tpu.ops.merge import (
+    merge_shards_device,
+    merge_sorted_host,
+    merge_sorted_host_streaming,
+)
+
+
+def test_merge_sorted_host_matches_numpy():
+    rng = np.random.default_rng(3)
+    chunks = [np.sort(rng.integers(-1000, 1000, n).astype(np.int32)) for n in (10, 0, 57, 3, 1000)]
+    out = merge_sorted_host(chunks)
+    np.testing.assert_array_equal(out, np.sort(np.concatenate(chunks)))
+
+
+def test_merge_sorted_host_single_and_empty():
+    assert len(merge_sorted_host([])) == 0
+    one = np.array([1, 2, 3], dtype=np.int32)
+    np.testing.assert_array_equal(merge_sorted_host([one]), one)
+
+
+def test_merge_streaming():
+    chunks = [np.array([1, 4, 7]), np.array([2, 5]), np.array([0, 9])]
+    assert list(merge_sorted_host_streaming(chunks)) == [0, 1, 2, 4, 5, 7, 9]
+
+
+def test_merge_shards_device():
+    import jax
+
+    rng = np.random.default_rng(4)
+    buf = rng.integers(-50, 50, (4, 8)).astype(np.int32)
+    counts = np.array([8, 3, 0, 5], dtype=np.int32)
+    sorted_shards, counts_j = jax.vmap(sort_padded)(jnp.asarray(buf), jnp.asarray(counts))
+    flat, total = merge_shards_device(sorted_shards, counts_j)
+    flat = np.asarray(flat)
+    valid = np.concatenate([buf[i, :c] for i, c in enumerate(counts)])
+    assert int(total) == len(valid)
+    np.testing.assert_array_equal(flat[: len(valid)], np.sort(valid))
+    assert (flat[len(valid):] == sentinel_for(np.int32)).all()
+
+
+def test_merge_sorted_host_preserves_dtype_when_all_empty():
+    out = merge_sorted_host([np.empty(0, np.int64), np.empty(0, np.int64)])
+    assert out.dtype == np.int64 and len(out) == 0

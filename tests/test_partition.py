@@ -1,0 +1,76 @@
+"""Partitioner tests — reference remainder semantics (server.c:185-216)."""
+
+import numpy as np
+import pytest
+
+from dsort_tpu.data.partition import (
+    equal_partition,
+    pad_kv_to_shards,
+    pad_to_shards,
+    partition,
+)
+from dsort_tpu.ops.local_sort import sentinel_for
+
+
+def test_equal_partition_reference_semantics():
+    # server.c:185-196: base = total/num, first total%num workers get one extra.
+    assert equal_partition(10_000, 4) == [2500, 2500, 2500, 2500]
+    assert equal_partition(10, 4) == [3, 3, 2, 2]
+    assert equal_partition(3, 4) == [1, 1, 1, 0]
+    assert equal_partition(0, 4) == [0, 0, 0, 0]
+    assert equal_partition(16_384, 4) == [4096] * 4  # reference max job
+
+
+def test_equal_partition_uncapped():
+    # The reference aborts above 4096/chunk (server.c:193-196); we must not.
+    sizes = equal_partition(1_000_000, 8)
+    assert sum(sizes) == 1_000_000
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_partition_concat_roundtrip():
+    data = np.arange(103, dtype=np.int32)
+    chunks = partition(data, 7)
+    assert len(chunks) == 7
+    np.testing.assert_array_equal(np.concatenate(chunks), data)
+
+
+def test_pad_to_shards_layout():
+    data = np.arange(10, dtype=np.int32)
+    shards, counts = pad_to_shards(data, 4, multiple=8)
+    assert shards.shape == (4, 8)
+    np.testing.assert_array_equal(counts, [3, 3, 2, 2])
+    sent = sentinel_for(np.int32)
+    recovered = np.concatenate([shards[i, : counts[i]] for i in range(4)])
+    np.testing.assert_array_equal(recovered, data)
+    assert (shards[0, 3:] == sent).all()
+
+
+def test_pad_kv_to_shards():
+    keys = np.arange(10, dtype=np.int64)
+    vals = np.stack([np.arange(10), np.arange(10) * 2], axis=1).astype(np.uint8)
+    sk, sv, counts = pad_kv_to_shards(keys, vals, 3)
+    rec_k = np.concatenate([sk[i, : counts[i]] for i in range(3)])
+    rec_v = np.concatenate([sv[i, : counts[i]] for i in range(3)])
+    np.testing.assert_array_equal(rec_k, keys)
+    np.testing.assert_array_equal(rec_v, vals)
+
+
+@pytest.mark.parametrize("n,w", [(0, 4), (1, 8), (7, 8), (64, 8)])
+def test_pad_to_shards_edge_sizes(n, w):
+    data = np.random.default_rng(0).integers(-100, 100, n).astype(np.int32)
+    shards, counts = pad_to_shards(data, w)
+    assert counts.sum() == n
+    rec = np.concatenate([shards[i, : counts[i]] for i in range(w)]) if n else []
+    np.testing.assert_array_equal(rec, data)
+
+
+def test_make_mesh_rejects_zero_worker_axis():
+    # Regression: dp > device count must raise, not build a (dp, 0) mesh.
+    import jax
+
+    from dsort_tpu.config import ConfigError, MeshConfig
+    from dsort_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ConfigError):
+        make_mesh(MeshConfig(dp=16), jax.devices()[:8])
