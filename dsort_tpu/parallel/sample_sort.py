@@ -1,0 +1,255 @@
+"""Distributed sample sort: splitters + all_to_all shuffle + per-chip merge.
+
+This is the TPU-native replacement for the reference's entire data plane: the
+master's paged TCP scatter (``server.c:342-398``), the workers' local sorts
+(``client.c:140-173``), the paged gather (``server.c:412-452``), and the
+centralized O(N*k) merge (``server.c:481-524``) all collapse into ONE jitted
+SPMD program over the device mesh:
+
+  1. each device sorts its local shard (``lax.sort``);
+  2. each device contributes ``oversample`` evenly-spaced sample keys;
+     an ``all_gather`` + replicated sort picks P-1 splitters (the sample-sort
+     analogue of choosing rotation boundaries, SURVEY.md §5.7);
+  3. since the local shard is sorted, each destination bucket is a contiguous
+     slice; slices are packed into a static ``(P, cap)`` send buffer;
+  4. one ``all_to_all`` over ICI redistributes buckets so device p owns the
+     p-th global key range — this is where the reference's O(N) master NIC
+     bottleneck becomes an O(N/P)-per-link collective;
+  5. each device merges its received runs (re-sort of the static buffer).
+
+Shapes are static (XLA requirement): buffers are padded with the dtype
+sentinel and carry valid counts.  Skewed inputs can overflow a bucket's
+static capacity; overflow is detected on-device and surfaced so the caller
+(``SampleSort.sort`` / the scheduler) retries with a larger capacity factor —
+the splitter-quality feedback loop SURVEY.md §7 calls out for Zipf inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dsort_tpu.config import JobConfig
+from dsort_tpu.data.partition import pad_kv_to_shards, pad_to_shards
+from dsort_tpu.ops.local_sort import sentinel_for, sort_padded
+from dsort_tpu.utils.logging import get_logger
+from dsort_tpu.utils.metrics import Metrics, PhaseTimer
+
+log = get_logger("sample_sort")
+
+
+def _choose_splitters(xs_sorted, count, num_workers: int, oversample: int, axis: str):
+    """Per-device samples -> all_gather -> P-1 global splitters (replicated)."""
+    s = oversample
+    n_local = xs_sorted.shape[0]
+    sent = sentinel_for(xs_sorted.dtype)
+    j = jnp.arange(s, dtype=jnp.float32)
+    idx = ((j + 0.5) * count.astype(jnp.float32) / s).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, max(n_local - 1, 0))
+    samples = jnp.where(count > 0, xs_sorted[idx], sent)
+    all_samples = jnp.sort(jax.lax.all_gather(samples, axis, tiled=True))
+    return all_samples[s * jnp.arange(1, num_workers)]
+
+
+def _bucket_slices(xs_sorted, count, splitters, cap_pair: int):
+    """Contiguous per-destination slices of a sorted shard, as static buffers.
+
+    Returns (gather_index, valid_mask, lens, overflow): index/mask shape
+    ``(P, cap_pair)`` selecting each destination's slice, ``lens`` the true
+    bucket sizes, ``overflow`` whether any bucket exceeded ``cap_pair``.
+    Keys equal to a splitter go to the splitter's right bucket (side='left'),
+    so bucket p holds exactly [splitters[p-1], splitters[p]).
+    """
+    n_local = xs_sorted.shape[0]
+    bounds = jnp.clip(
+        jnp.searchsorted(xs_sorted, splitters, side="left").astype(jnp.int32),
+        0,
+        count,
+    )
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), bounds])
+    ends = jnp.concatenate([bounds, count[None].astype(jnp.int32)])
+    lens = jnp.maximum(ends - starts, 0)
+    overflow = jnp.any(lens > cap_pair)
+    gidx = starts[:, None] + jnp.arange(cap_pair, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(cap_pair, dtype=jnp.int32)[None, :] < lens[:, None]
+    return jnp.clip(gidx, 0, max(n_local - 1, 0)), valid, lens, overflow
+
+
+def _sample_sort_shard(xs, count, *, num_workers, oversample, cap_pair, axis):
+    """One device's view of the whole distributed sort (runs under shard_map).
+
+    ``xs``: (n_local,) sentinel-padded keys; ``count``: (1,) valid length.
+    Returns (merged (P*cap_pair,), out_count (1,), overflow (1,)).
+    """
+    sent = sentinel_for(xs.dtype)
+    count = count[0]
+    xs, _ = sort_padded(xs, count)                                   # phase 1
+    splitters = _choose_splitters(xs, count, num_workers, oversample, axis)  # 2
+    gidx, valid, lens, overflow = _bucket_slices(xs, count, splitters, cap_pair)  # 3
+    send = jnp.where(valid, xs[gidx], sent)
+    recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)       # 4
+    lens_recv = jax.lax.all_to_all(lens[:, None], axis, split_axis=0, concat_axis=0)[:, 0]
+    merged = jnp.sort(recv.reshape(-1))                                      # 5
+    out_count = jnp.sum(lens_recv).astype(jnp.int32)
+    return merged, out_count[None], overflow[None]
+
+
+def _sample_sort_kv_shard(keys, payload, count, *, num_workers, oversample, cap_pair, axis):
+    """Key+payload variant (TeraSort records): payload rides the same shuffle."""
+    from dsort_tpu.ops.local_sort import sort_kv_padded
+
+    sent = sentinel_for(keys.dtype)
+    count = count[0]
+    keys, payload, _ = sort_kv_padded(keys, payload, count)
+    splitters = _choose_splitters(keys, count, num_workers, oversample, axis)
+    gidx, valid, lens, overflow = _bucket_slices(keys, count, splitters, cap_pair)
+    send_k = jnp.where(valid, keys[gidx], sent)
+    send_v = payload[gidx]  # (P, cap_pair, ...) — invalid rows masked by count downstream
+    recv_k = jax.lax.all_to_all(send_k, axis, split_axis=0, concat_axis=0)
+    recv_v = jax.lax.all_to_all(send_v, axis, split_axis=0, concat_axis=0)
+    lens_recv = jax.lax.all_to_all(lens[:, None], axis, split_axis=0, concat_axis=0)[:, 0]
+    # Re-derive validity after the exchange, then 2-key sort (key, is_pad) so
+    # real keys equal to the sentinel keep their payloads (no reserved keys).
+    pos = jnp.arange(cap_pair, dtype=jnp.int32)[None, :]
+    is_pad = (pos >= lens_recv[:, None]).reshape(-1).astype(jnp.int8)
+    flat_k = jnp.where(is_pad.astype(bool), sent, recv_k.reshape(-1))
+    flat_v = recv_v.reshape((-1,) + recv_v.shape[2:])
+    idx = jnp.arange(flat_k.shape[0], dtype=jnp.int32)
+    out_k, _, perm = jax.lax.sort((flat_k, is_pad, idx), dimension=-1, num_keys=2)
+    from dsort_tpu.ops.local_sort import _apply_perm
+
+    out_v = _apply_perm(flat_v, perm, 0)
+    out_count = jnp.sum(lens_recv).astype(jnp.int32)
+    return out_k, out_v, out_count[None], overflow[None]
+
+
+class SampleSort:
+    """Host-facing driver for the SPMD sample sort over a 1-D worker mesh.
+
+    Handles padding/layout, jit caching per shape, overflow retries with a
+    growing capacity factor, and global assembly of the sorted output.
+    """
+
+    def __init__(self, mesh: Mesh, job: JobConfig | None = None, axis_name: str = "w"):
+        self.mesh = mesh
+        self.axis = axis_name
+        self.job = job or JobConfig()
+        self.num_workers = mesh.shape[axis_name]
+
+    @functools.lru_cache(maxsize=32)
+    def _build(self, n_local: int, cap_pair: int, kv_trailing: tuple):
+        """Compile the shard_map'd program for one (shape, capacity) combo."""
+        p = self.num_workers
+        kwargs = dict(
+            num_workers=p,
+            oversample=self.job.oversample,
+            cap_pair=cap_pair,
+            axis=self.axis,
+        )
+        if kv_trailing is None:
+            fn = functools.partial(_sample_sort_shard, **kwargs)
+            in_specs = (P(self.axis), P(self.axis))
+            out_specs = (P(self.axis), P(self.axis), P(self.axis))
+        else:
+            fn = functools.partial(_sample_sort_kv_shard, **kwargs)
+            in_specs = (P(self.axis), P(self.axis), P(self.axis))
+            out_specs = (P(self.axis), P(self.axis), P(self.axis), P(self.axis))
+        return jax.jit(
+            jax.shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    def _cap_pair(self, n_local: int, factor: float) -> int:
+        """Static per-(src,dst) bucket capacity, 8-aligned, <= n_local."""
+        cap = int(np.ceil(factor * n_local / self.num_workers))
+        cap = min(-(-cap // 8) * 8, max(n_local, 8))
+        return max(cap, 8)
+
+    def sort(self, data: np.ndarray, metrics: Metrics | None = None) -> np.ndarray:
+        """Sort a host array; returns the globally sorted host array."""
+        metrics = metrics if metrics is not None else Metrics()
+        timer = PhaseTimer(metrics)
+        p = self.num_workers
+        if len(data) == 0:
+            return np.asarray(data).copy()
+        with timer.phase("partition"):
+            shards, counts = pad_to_shards(data, p)
+            sharding = NamedSharding(self.mesh, P(self.axis, None))
+            xs = jax.device_put(
+                jnp.asarray(shards).reshape(-1), NamedSharding(self.mesh, P(self.axis))
+            )
+            cj = jax.device_put(jnp.asarray(counts), NamedSharding(self.mesh, P(self.axis)))
+        n_local = shards.shape[1]
+        factor = self.job.capacity_factor
+        for attempt in range(self.job.max_capacity_retries + 1):
+            cap_pair = self._cap_pair(n_local, factor)
+            fn = self._build(n_local, cap_pair, None)
+            with timer.phase("spmd_sort"):
+                merged, out_counts, overflow = fn(xs, cj)
+                merged.block_until_ready()
+            if not bool(np.asarray(overflow).any()):
+                break
+            metrics.bump("capacity_retries")
+            factor *= 2.0
+            log.warning(
+                "bucket overflow (attempt %d): retrying with capacity_factor=%.1f",
+                attempt + 1,
+                factor,
+            )
+        else:
+            raise RuntimeError("sample sort bucket overflow after max retries")
+        with timer.phase("assemble"):
+            m = np.asarray(merged).reshape(p, -1)
+            c = np.asarray(out_counts)
+            out = np.concatenate([m[i, : c[i]] for i in range(p)])
+        return out
+
+    def sort_kv(
+        self,
+        keys: np.ndarray,
+        payload: np.ndarray,
+        metrics: Metrics | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """TeraSort-style key+payload sort; payloads follow their keys."""
+        metrics = metrics if metrics is not None else Metrics()
+        timer = PhaseTimer(metrics)
+        p = self.num_workers
+        if len(keys) == 0:
+            return np.asarray(keys).copy(), np.asarray(payload).copy()
+        with timer.phase("partition"):
+            sk, sv, counts = pad_kv_to_shards(keys, payload, p)
+            xs = jax.device_put(
+                jnp.asarray(sk).reshape(-1), NamedSharding(self.mesh, P(self.axis))
+            )
+            vs = jax.device_put(
+                jnp.asarray(sv).reshape((-1,) + sv.shape[2:]),
+                NamedSharding(self.mesh, P(self.axis)),
+            )
+            cj = jax.device_put(jnp.asarray(counts), NamedSharding(self.mesh, P(self.axis)))
+        n_local = sk.shape[1]
+        factor = self.job.capacity_factor
+        for attempt in range(self.job.max_capacity_retries + 1):
+            cap_pair = self._cap_pair(n_local, factor)
+            fn = self._build(n_local, cap_pair, tuple(sv.shape[2:]))
+            with timer.phase("spmd_sort"):
+                out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
+                out_k.block_until_ready()
+            if not bool(np.asarray(overflow).any()):
+                break
+            metrics.bump("capacity_retries")
+            factor *= 2.0
+        else:
+            raise RuntimeError("sample sort bucket overflow after max retries")
+        with timer.phase("assemble"):
+            mk = np.asarray(out_k).reshape(p, -1)
+            mv = np.asarray(out_v).reshape((p, mk.shape[1]) + sv.shape[2:])
+            c = np.asarray(out_counts)
+            keys_out = np.concatenate([mk[i, : c[i]] for i in range(p)])
+            vals_out = np.concatenate([mv[i, : c[i]] for i in range(p)])
+        return keys_out, vals_out
