@@ -1,0 +1,13 @@
+"""Job driver, liveness, and reassign-on-failure fault tolerance (L3)."""
+
+from dsort_tpu.scheduler.liveness import WorkerState, WorkerTable  # noqa: F401
+from dsort_tpu.scheduler.fault import (  # noqa: F401
+    FaultInjector,
+    JobFailedError,
+    WorkerFailure,
+)
+from dsort_tpu.scheduler.scheduler import (  # noqa: F401
+    DeviceExecutor,
+    Scheduler,
+    SpmdScheduler,
+)

@@ -1,0 +1,89 @@
+"""Failure types and fault injection (SURVEY.md §5.3's missing piece).
+
+The reference has no built-in fault injection — its fault tolerance was
+evidently validated by externally ``kill -9``-ing a client process.  Here
+injection is a first-class hook (BASELINE config #5): kill a worker
+permanently, or trip a one-shot failure at a chosen point of the exchange
+(before dispatch / during send / during recv — the reference's two detection
+sites, ``server.c:358`` and ``server.c:421``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class WorkerFailure(RuntimeError):
+    """A worker died mid-exchange — the ``send()/recv() <= 0`` analogue."""
+
+    def __init__(self, worker: int, stage: str = "exchange"):
+        super().__init__(f"worker {worker} failed during {stage}")
+        self.worker = worker
+        self.stage = stage
+
+
+class JobFailedError(RuntimeError):
+    """No live workers remain; the job fails cleanly, the cluster survives.
+
+    The reference's equivalent silently skips the merge and re-prompts
+    (``server.c:265-268`` gate after ``pthread_exit`` at ``server.c:387-390``);
+    we surface it as an exception instead of silence.
+    """
+
+
+class FaultInjector:
+    """Programmable failure source, threaded through the executor.
+
+    - `kill(worker)`: permanent — every subsequent exchange on that worker
+      fails (the ``kill -9`` experiment from SURVEY.md §0).
+    - `fail_once(worker, stage)`: one-shot — the next exchange at ``stage``
+      ("send" | "sort" | "recv") on that worker fails, then the worker works
+      again (models a transient drop; the reference would also re-detect a
+      revived-then-dead worker this way via its per-job revival).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._killed: set[int] = set()
+        self._one_shots: dict[tuple[int, str], int] = {}
+        self._hangs: dict[tuple[int, str], float] = {}
+        self.trips = 0
+
+    def kill(self, worker: int) -> None:
+        with self._lock:
+            self._killed.add(worker)
+
+    def revive(self, worker: int) -> None:
+        with self._lock:
+            self._killed.discard(worker)
+
+    def fail_once(self, worker: int, stage: str = "send", times: int = 1) -> None:
+        with self._lock:
+            self._one_shots[(worker, stage)] = (
+                self._one_shots.get((worker, stage), 0) + times
+            )
+
+    def hang_once(self, worker: int, stage: str = "sort", seconds: float = 3600.0) -> None:
+        """Next exchange at ``stage`` stalls for ``seconds`` — models the hung
+        worker the reference can never detect (SURVEY.md §5.3)."""
+        with self._lock:
+            self._hangs[(worker, stage)] = seconds
+
+    def check(self, worker: int, stage: str) -> None:
+        """Raise WorkerFailure (or stall) if an injected fault applies here."""
+        with self._lock:
+            hang = self._hangs.pop((worker, stage), None)
+            if hang is None:
+                if worker in self._killed:
+                    self.trips += 1
+                    raise WorkerFailure(worker, stage)
+                left = self._one_shots.get((worker, stage), 0)
+                if left > 0:
+                    self._one_shots[(worker, stage)] = left - 1
+                    self.trips += 1
+                    raise WorkerFailure(worker, stage)
+        if hang is not None:
+            self.trips += 1
+            import time
+
+            time.sleep(hang)
