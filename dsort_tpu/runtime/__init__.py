@@ -1,0 +1,4 @@
+"""Native C++ runtime bindings: k-way merge, worker table, TCP coordinator."""
+
+from dsort_tpu.runtime import native  # noqa: F401
+from dsort_tpu.runtime.coordinator import NativeCoordinator  # noqa: F401

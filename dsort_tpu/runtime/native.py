@@ -1,0 +1,217 @@
+"""ctypes bindings to libdsort_native (no pybind11 in this image).
+
+Wraps the native k-way merge (the O(N log k) replacement of the reference's
+O(N*k) ``merge_chunks``, ``server.c:481-524``) and the native worker liveness
+table.  The library is built from ``dsort_tpu/runtime/native/`` via make; if
+the .so is missing we attempt one best-effort build and otherwise report
+unavailable so pure-Python fallbacks take over.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_DIR, "libdsort_native.so")
+_lib = None
+_lib_lock = threading.Lock()
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    with _lib_lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR], capture_output=True, timeout=120, check=True
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        # K-way merge signatures.
+        for name in ("i32", "i64", "u64"):
+            fn = getattr(lib, f"dsort_kway_merge_{name}")
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int32,
+                ctypes.c_void_p,
+            ]
+        for name in ("u64", "i64"):
+            fn = getattr(lib, f"dsort_kway_merge_kv_{name}")
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+        lib.dsort_table_create.restype = ctypes.c_void_p
+        lib.dsort_table_create.argtypes = [ctypes.c_int32, ctypes.c_double]
+        lib.dsort_table_destroy.argtypes = [ctypes.c_void_p]
+        lib.dsort_table_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
+        lib.dsort_table_is_alive.restype = ctypes.c_int32
+        lib.dsort_table_is_alive.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.dsort_table_mark_dead.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.dsort_table_first_live.restype = ctypes.c_int32
+        lib.dsort_table_first_live.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.dsort_table_check_heartbeats.restype = ctypes.c_int32
+        lib.dsort_table_check_heartbeats.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.dsort_table_revive_all.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.dsort_table_death_count.restype = ctypes.c_int32
+        lib.dsort_table_death_count.argtypes = [ctypes.c_void_p]
+        lib.dsort_table_live_count.restype = ctypes.c_int32
+        lib.dsort_table_live_count.argtypes = [ctypes.c_void_p]
+        # Coordinator.
+        lib.dsort_coord_create.restype = ctypes.c_void_p
+        lib.dsort_coord_create.argtypes = [ctypes.c_uint16, ctypes.c_double]
+        lib.dsort_coord_port.restype = ctypes.c_int32
+        lib.dsort_coord_port.argtypes = [ctypes.c_void_p]
+        lib.dsort_coord_wait_workers.restype = ctypes.c_int32
+        lib.dsort_coord_wait_workers.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
+        lib.dsort_coord_num_live.restype = ctypes.c_int32
+        lib.dsort_coord_num_live.argtypes = [ctypes.c_void_p]
+        lib.dsort_coord_submit.restype = ctypes.c_int32
+        lib.dsort_coord_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.dsort_coord_collect.restype = ctypes.c_int64
+        lib.dsort_coord_collect.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double,
+        ]
+        lib.dsort_coord_kill_worker.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.dsort_coord_reassignments.restype = ctypes.c_int32
+        lib.dsort_coord_reassignments.argtypes = [ctypes.c_void_p]
+        lib.dsort_coord_shutdown.argtypes = [ctypes.c_void_p]
+        lib.dsort_coord_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+_MERGE_FNS = {
+    np.dtype(np.int32): "dsort_kway_merge_i32",
+    np.dtype(np.int64): "dsort_kway_merge_i64",
+    np.dtype(np.uint64): "dsort_kway_merge_u64",
+}
+_MERGE_KV_FNS = {
+    np.dtype(np.uint64): "dsort_kway_merge_kv_u64",
+    np.dtype(np.int64): "dsort_kway_merge_kv_i64",
+}
+
+
+def supports_dtype(dtype) -> bool:
+    return np.dtype(dtype) in _MERGE_FNS
+
+
+def _run_ptrs(runs: list[np.ndarray]):
+    arr_t = ctypes.c_void_p * len(runs)
+    ptrs = arr_t(*[r.ctypes.data_as(ctypes.c_void_p) for r in runs])
+    lens = (ctypes.c_int64 * len(runs))(*[len(r) for r in runs])
+    return ptrs, lens
+
+
+def kway_merge(runs: list[np.ndarray]) -> np.ndarray:
+    """Heap k-way merge of sorted runs in native code."""
+    lib = _load()
+    runs = [np.ascontiguousarray(r) for r in runs]
+    dtype = runs[0].dtype
+    fn = getattr(lib, _MERGE_FNS[dtype])
+    out = np.empty(sum(len(r) for r in runs), dtype=dtype)
+    ptrs, lens = _run_ptrs(runs)
+    fn(ptrs, lens, len(runs), out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def kway_merge_kv(
+    key_runs: list[np.ndarray], val_runs: list[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray]:
+    """Native k-way merge of (key, fixed-width payload) sorted runs."""
+    lib = _load()
+    key_runs = [np.ascontiguousarray(r) for r in key_runs]
+    val_runs = [np.ascontiguousarray(r) for r in val_runs]
+    dtype = key_runs[0].dtype
+    fn = getattr(lib, _MERGE_KV_FNS[dtype])
+    pbytes = int(val_runs[0][0].nbytes) if len(val_runs[0]) else int(
+        np.prod(val_runs[0].shape[1:]) * val_runs[0].itemsize
+    )
+    total = sum(len(r) for r in key_runs)
+    out_k = np.empty(total, dtype=dtype)
+    out_v = np.empty((total,) + val_runs[0].shape[1:], dtype=val_runs[0].dtype)
+    kptrs, lens = _run_ptrs(key_runs)
+    vptrs, _ = _run_ptrs(val_runs)
+    fn(kptrs, vptrs, lens, len(key_runs), pbytes,
+       out_k.ctypes.data_as(ctypes.c_void_p), out_v.ctypes.data_as(ctypes.c_void_p))
+    return out_k, out_v
+
+
+class NativeWorkerTable:
+    """Native-backed drop-in for `scheduler.liveness.WorkerTable`."""
+
+    def __init__(self, num_workers: int, heartbeat_timeout_s: float = 10.0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.dsort_table_create(num_workers, heartbeat_timeout_s)
+        self.num_workers = num_workers
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.dsort_table_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def heartbeat(self, worker: int) -> None:
+        self._lib.dsort_table_heartbeat(self._h, worker, time.monotonic())
+
+    def is_alive(self, worker: int) -> bool:
+        return bool(self._lib.dsort_table_is_alive(self._h, worker))
+
+    def mark_dead(self, worker: int) -> None:
+        self._lib.dsort_table_mark_dead(self._h, worker)
+
+    def first_live(self, exclude: int | None = None) -> int | None:
+        r = self._lib.dsort_table_first_live(
+            self._h, -1 if exclude is None else exclude
+        )
+        return None if r < 0 else r
+
+    def live_workers(self) -> list[int]:
+        return [i for i in range(self.num_workers) if self.is_alive(i)]
+
+    def check_heartbeats(self) -> list[int]:
+        out = (ctypes.c_int32 * self.num_workers)()
+        n = self._lib.dsort_table_check_heartbeats(self._h, time.monotonic(), out)
+        return list(out[:n])
+
+    def revive_all(self) -> None:
+        self._lib.dsort_table_revive_all(self._h, time.monotonic())
+
+    @property
+    def death_count(self) -> int:
+        return self._lib.dsort_table_death_count(self._h)

@@ -1,0 +1,459 @@
+// dsort coordinator — native TCP control plane (SURVEY.md §2.4 item 1).
+//
+// The DCN-path successor of the reference master's listener + worker_handler
+// machinery (server.c:120-157, 297-477), speaking a length-prefixed framed
+// protocol to Python/JAX worker shims instead of raw sentinel-terminated
+// int32 pages (the reference's framing reserves key value -1 on the wire,
+// server.c:405-406; length-prefixed frames reserve nothing).  Kept semantics,
+// verified in SURVEY.md §5.3:
+//   - passive in-band death detection (send/recv failure) — plus heartbeat
+//     frames with a timeout monitor, fixing the reference's hang-blindness;
+//   - whole-task retry on the first live worker (linear scan from 0), with
+//     results pinned to the task id regardless of executor;
+//   - clean job failure when no workers remain; the coordinator survives;
+//   - unlike the reference (membership frozen at the initial accepts,
+//     server.c:148-157), late/rejoining workers are accepted as new slots.
+//
+// Frame format (little-endian): u32 type | u32 task_id | u64 len | bytes.
+// Types: 1 TASK (coord->worker), 2 RESULT (worker->coord),
+//        3 HEARTBEAT (worker->coord), 4 SHUTDOWN (coord->worker).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kTask = 1;
+constexpr uint32_t kResult = 2;
+constexpr uint32_t kHeartbeat = 3;
+constexpr uint32_t kShutdown = 4;
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+bool read_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);  // no SIGPIPE (server.c:108-116)
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+struct FrameHeader {
+  uint32_t type;
+  uint32_t task_id;
+  uint64_t len;
+} __attribute__((packed));
+
+struct Worker {
+  int fd = -1;
+  bool alive = false;
+  double last_hb = 0.0;
+  // Per-socket send mutex: during reassignment a foreign task borrows a live
+  // worker's socket; serialize like the reference's w_socket_mutexes
+  // (server.c:23,321-346) — but only around sends; frames make interleaved
+  // receives unambiguous, so no exchange-long lock is needed.
+  std::unique_ptr<std::mutex> send_mu = std::make_unique<std::mutex>();
+  std::thread reader;
+};
+
+enum class TaskState { kPending, kSent, kDone, kFailed };
+
+struct Task {
+  std::vector<uint8_t> data;
+  std::vector<uint8_t> result;
+  TaskState state = TaskState::kPending;
+  int assigned = -1;
+};
+
+class Coordinator {
+ public:
+  Coordinator(uint16_t port, double hb_timeout)
+      : hb_timeout_(hb_timeout) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(port);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 64) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    monitor_thread_ = std::thread([this] { monitor_loop(); });
+  }
+
+  ~Coordinator() { shutdown(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  uint16_t port() const { return port_; }
+
+  int wait_workers(int n, double timeout_s) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                 [&] { return total_connected_ >= n || stopping_; });
+    return total_connected_;
+  }
+
+  int num_live() {
+    std::lock_guard<std::mutex> lk(mu_);
+    int c = 0;
+    for (auto& w : workers_)
+      if (w->alive) ++c;
+    return c;
+  }
+
+  // Submit a task; dispatch happens inline (retrying across live workers).
+  // Returns 0 on queued+sent, -1 when no live worker could take it.
+  int submit(uint32_t task_id, const uint8_t* data, uint64_t len) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      Task& t = tasks_[task_id];
+      t.data.assign(data, data + len);
+      t.state = TaskState::kPending;
+      t.assigned = -1;
+    }
+    return dispatch(task_id) ? 0 : -1;
+  }
+
+  // Block until the task completes; returns result length, -1 on job failure
+  // (no live workers), -2 on timeout.  Result pinned to task_id.
+  int64_t collect(uint32_t task_id, uint8_t* out, uint64_t cap, double timeout_s) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool done = cv_.wait_for(
+        lk, std::chrono::duration<double>(timeout_s), [&] {
+          auto it = tasks_.find(task_id);
+          return it != tasks_.end() && (it->second.state == TaskState::kDone ||
+                                        it->second.state == TaskState::kFailed);
+        });
+    if (!done) return -2;
+    Task& t = tasks_[task_id];
+    if (t.state == TaskState::kFailed) return -1;
+    uint64_t n = t.result.size();
+    if (n > cap) return -3;
+    std::memcpy(out, t.result.data(), n);
+    return static_cast<int64_t>(n);
+  }
+
+  // Fault injection: hard-close a worker's socket (the kill -9 experiment).
+  void kill_worker(int w) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (w >= 0 && w < static_cast<int>(workers_.size()) && workers_[w]->alive) {
+      ::shutdown(workers_[w]->fd, SHUT_RDWR);
+    }
+  }
+
+  void shutdown() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stopping_) return;
+      stopping_ = true;
+      for (auto& w : workers_) {
+        if (w->alive) {
+          FrameHeader h{kShutdown, 0, 0};
+          std::lock_guard<std::mutex> slk(*w->send_mu);
+          send_all(w->fd, &h, sizeof(h));
+        }
+        if (w->fd >= 0) ::shutdown(w->fd, SHUT_RDWR);
+      }
+      if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    }
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (monitor_thread_.joinable()) monitor_thread_.join();
+    // Join readers WITHOUT holding mu_: a dying reader runs on_worker_down,
+    // which needs mu_ — joining under the lock deadlocks against it.
+    std::vector<std::thread> readers;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (auto& w : workers_) {
+        if (w->reader.joinable()) readers.push_back(std::move(w->reader));
+      }
+    }
+    for (auto& t : readers) t.join();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (auto& w : workers_) {
+        if (w->fd >= 0) ::close(w->fd);
+        w->fd = -1;
+      }
+      if (listen_fd_ >= 0) ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+  }
+
+  int reassignments() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return reassignments_;
+  }
+
+ private:
+  void accept_loop() {
+    while (true) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;  // listener closed
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      int idx;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (stopping_) {
+          ::close(fd);
+          return;
+        }
+        workers_.push_back(std::make_unique<Worker>());
+        idx = static_cast<int>(workers_.size()) - 1;
+        Worker& w = *workers_[idx];
+        w.fd = fd;
+        w.alive = true;
+        w.last_hb = now_s();
+        ++total_connected_;
+        w.reader = std::thread([this, idx] { reader_loop(idx); });
+      }
+      cv_.notify_all();
+    }
+  }
+
+  void reader_loop(int widx) {
+    int fd;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      fd = workers_[widx]->fd;
+    }
+    while (true) {
+      FrameHeader h;
+      if (fd < 0 || !read_exact(fd, &h, sizeof(h))) break;
+      if (h.type == kHeartbeat) {
+        std::lock_guard<std::mutex> lk(mu_);
+        workers_[widx]->last_hb = now_s();
+        continue;
+      }
+      if (h.type == kResult) {
+        std::vector<uint8_t> payload(h.len);
+        if (h.len > 0 && !read_exact(fd, payload.data(), h.len)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          workers_[widx]->last_hb = now_s();
+          auto it = tasks_.find(h.task_id);
+          if (it != tasks_.end() && it->second.state == TaskState::kSent) {
+            it->second.result = std::move(payload);
+            it->second.state = TaskState::kDone;
+          }
+        }
+        cv_.notify_all();
+        continue;
+      }
+      break;  // unknown frame: treat as protocol death
+    }
+    on_worker_down(widx);
+  }
+
+  // Death handling: mark dead and retry this worker's in-flight tasks whole
+  // on the first live worker (server.c:367-401 semantics).
+  void on_worker_down(int widx) {
+    std::vector<uint32_t> orphans;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      Worker& w = *workers_[widx];
+      if (!w.alive) return;
+      w.alive = false;
+      for (auto& [id, t] : tasks_) {
+        if (t.state == TaskState::kSent && t.assigned == widx) {
+          t.state = TaskState::kPending;
+          ++reassignments_;  // recv-path detection (server.c:421-448)
+          orphans.push_back(id);
+        }
+      }
+    }
+    cv_.notify_all();
+    for (uint32_t id : orphans) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));  // server.c:391
+      dispatch(id);
+    }
+  }
+
+  bool dispatch(uint32_t task_id) {
+    bool first_try = true;
+    while (true) {
+      int target = -1;
+      Worker* w = nullptr;  // Worker objects are unique_ptr-held: stable
+                            // across workers_ growth, safe to use unlocked.
+      FrameHeader h{kTask, task_id, 0};
+      std::vector<uint8_t>* data_ptr = nullptr;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        int n = static_cast<int>(workers_.size());
+        // Prefer the task-affine worker (reference: chunk i <-> worker i,
+        // server.c:231-257); otherwise linear-scan first live
+        // (server.c:368-384).
+        int affine = n > 0 ? static_cast<int>(task_id) % n : -1;
+        if (first_try && affine >= 0 && workers_[affine]->alive) {
+          target = affine;
+        } else {
+          for (int i = 0; i < n; ++i) {
+            if (workers_[i]->alive) {
+              target = i;
+              break;
+            }
+          }
+        }
+        auto it = tasks_.find(task_id);
+        if (it == tasks_.end()) return false;
+        if (target < 0) {
+          it->second.state = TaskState::kFailed;  // clean job failure
+          cv_.notify_all();
+          return false;
+        }
+        w = workers_[target].get();
+        it->second.assigned = target;
+        it->second.state = TaskState::kSent;
+        data_ptr = &it->second.data;
+        h.len = data_ptr->size();
+      }
+      first_try = false;
+      bool sent;
+      {
+        std::lock_guard<std::mutex> slk(*w->send_mu);
+        sent = send_all(w->fd, &h, sizeof(h)) &&
+               (h.len == 0 || send_all(w->fd, data_ptr->data(), h.len));
+      }
+      if (sent) return true;
+      // Send failed: in-band death detection (server.c:358); mark + retry.
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (workers_[target]->alive) {
+          workers_[target]->alive = false;
+        }
+        auto it = tasks_.find(task_id);
+        it->second.state = TaskState::kPending;
+        ++reassignments_;
+      }
+      cv_.notify_all();
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+  }
+
+  void monitor_loop() {
+    while (true) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (cv_.wait_for(lk, std::chrono::milliseconds(200),
+                         [&] { return stopping_; }))
+          return;
+        double t = now_s();
+        for (int i = 0; i < static_cast<int>(workers_.size()); ++i) {
+          Worker& w = *workers_[i];
+          if (w.alive && hb_timeout_ > 0 && t - w.last_hb > hb_timeout_) {
+            // Hang-blindness fix: no heartbeat -> force the socket closed;
+            // the reader thread then runs the normal death path.
+            ::shutdown(w.fd, SHUT_RDWR);
+          }
+        }
+      }
+    }
+  }
+
+  int listen_fd_ = -1;
+  uint16_t port_ = 0;
+  double hb_timeout_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<std::unique_ptr<Worker>> workers_;
+  std::map<uint32_t, Task> tasks_;
+  int total_connected_ = 0;
+  int reassignments_ = 0;
+  bool stopping_ = false;
+  std::thread accept_thread_;
+  std::thread monitor_thread_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dsort_coord_create(uint16_t port, double hb_timeout) {
+  auto* c = new Coordinator(port, hb_timeout);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int32_t dsort_coord_port(void* c) {
+  return static_cast<Coordinator*>(c)->port();
+}
+
+int32_t dsort_coord_wait_workers(void* c, int32_t n, double timeout_s) {
+  return static_cast<Coordinator*>(c)->wait_workers(n, timeout_s);
+}
+
+int32_t dsort_coord_num_live(void* c) {
+  return static_cast<Coordinator*>(c)->num_live();
+}
+
+int32_t dsort_coord_submit(void* c, uint32_t task_id, const uint8_t* data,
+                           uint64_t len) {
+  return static_cast<Coordinator*>(c)->submit(task_id, data, len);
+}
+
+int64_t dsort_coord_collect(void* c, uint32_t task_id, uint8_t* out,
+                            uint64_t cap, double timeout_s) {
+  return static_cast<Coordinator*>(c)->collect(task_id, out, cap, timeout_s);
+}
+
+void dsort_coord_kill_worker(void* c, int32_t w) {
+  static_cast<Coordinator*>(c)->kill_worker(w);
+}
+
+int32_t dsort_coord_reassignments(void* c) {
+  return static_cast<Coordinator*>(c)->reassignments();
+}
+
+void dsort_coord_shutdown(void* c) {
+  static_cast<Coordinator*>(c)->shutdown();
+}
+
+void dsort_coord_destroy(void* c) {
+  static_cast<Coordinator*>(c)->shutdown();
+  delete static_cast<Coordinator*>(c);
+}
+
+}  // extern "C"

@@ -1,0 +1,260 @@
+// dsort_native — native runtime core for dsort_tpu.
+//
+// Native parity with the reference's C master (SURVEY.md §2.4): the
+// reference implements its k-way merge (server.c:481-524, an O(N*k) linear
+// min-scan) and its scheduler/liveness state machine (server.c:19,297-477)
+// in C.  This library provides the TPU framework's equivalents:
+//
+//  - an O(N log k) binary-heap k-way merge over sorted runs (key-only for
+//    int32/int64/uint64, and key+fixed-width-payload for TeraSort records),
+//    used by the host data plane for egress assembly;
+//  - a thread-safe worker liveness table with heartbeat timestamps and
+//    linear-scan first-live lookup — the reassign-on-failure state machine
+//    with the reference's verified semantics (mark-dead, first-live scan,
+//    per-job optimistic revival) minus its unlocked is_alive[] race
+//    (SURVEY.md §5.2).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// K-way merge: binary min-heap of run heads.
+// ---------------------------------------------------------------------------
+
+template <typename K>
+struct HeapNode {
+  K key;
+  int32_t run;
+};
+
+template <typename K>
+class RunHeap {
+ public:
+  explicit RunHeap(int32_t capacity) { nodes_.reserve(capacity); }
+
+  void push(K key, int32_t run) {
+    nodes_.push_back({key, run});
+    size_t i = nodes_.size() - 1;
+    while (i > 0) {
+      size_t parent = (i - 1) / 2;
+      if (nodes_[parent].key <= nodes_[i].key) break;
+      std::swap(nodes_[parent], nodes_[i]);
+      i = parent;
+    }
+  }
+
+  HeapNode<K> pop() {
+    HeapNode<K> top = nodes_[0];
+    nodes_[0] = nodes_.back();
+    nodes_.pop_back();
+    size_t i = 0, n = nodes_.size();
+    while (true) {
+      size_t l = 2 * i + 1, r = 2 * i + 2, m = i;
+      if (l < n && nodes_[l].key < nodes_[m].key) m = l;
+      if (r < n && nodes_[r].key < nodes_[m].key) m = r;
+      if (m == i) break;
+      std::swap(nodes_[i], nodes_[m]);
+      i = m;
+    }
+    return top;
+  }
+
+  bool empty() const { return nodes_.empty(); }
+
+ private:
+  std::vector<HeapNode<K>> nodes_;
+};
+
+template <typename K>
+void kway_merge(const K** runs, const int64_t* lens, int32_t nruns, K* out) {
+  RunHeap<K> heap(nruns);
+  std::vector<int64_t> pos(nruns, 0);
+  for (int32_t r = 0; r < nruns; ++r) {
+    if (lens[r] > 0) heap.push(runs[r][0], r);
+  }
+  int64_t o = 0;
+  while (!heap.empty()) {
+    HeapNode<K> top = heap.pop();
+    out[o++] = top.key;
+    int64_t p = ++pos[top.run];
+    if (p < lens[top.run]) heap.push(runs[top.run][p], top.run);
+  }
+}
+
+template <typename K>
+void kway_merge_kv(const K** kruns, const uint8_t** vruns, const int64_t* lens,
+                   int32_t nruns, int32_t pbytes, K* out_k, uint8_t* out_v) {
+  RunHeap<K> heap(nruns);
+  std::vector<int64_t> pos(nruns, 0);
+  for (int32_t r = 0; r < nruns; ++r) {
+    if (lens[r] > 0) heap.push(kruns[r][0], r);
+  }
+  int64_t o = 0;
+  while (!heap.empty()) {
+    HeapNode<K> top = heap.pop();
+    int64_t p = pos[top.run];
+    out_k[o] = top.key;
+    std::memcpy(out_v + o * pbytes, vruns[top.run] + p * pbytes, pbytes);
+    ++o;
+    if (++pos[top.run] < lens[top.run])
+      heap.push(kruns[top.run][pos[top.run]], top.run);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Worker liveness table.
+// ---------------------------------------------------------------------------
+
+class WorkerTable {
+ public:
+  WorkerTable(int32_t n, double timeout_s)
+      : timeout_s_(timeout_s), alive_(n, 1), last_hb_(n, 0.0), deaths_(0) {}
+
+  void heartbeat(int32_t w, double now) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (valid(w)) last_hb_[w] = now;
+  }
+
+  int32_t is_alive(int32_t w) {
+    std::lock_guard<std::mutex> g(mu_);
+    return valid(w) ? alive_[w] : 0;
+  }
+
+  void mark_dead(int32_t w) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (valid(w) && alive_[w]) {
+      alive_[w] = 0;
+      ++deaths_;
+    }
+  }
+
+  // Linear scan from 0 (server.c:368-384 semantics); -1 when none live.
+  int32_t first_live(int32_t exclude) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (int32_t i = 0; i < (int32_t)alive_.size(); ++i) {
+      if (i != exclude && alive_[i]) return i;
+    }
+    return -1;
+  }
+
+  int32_t check_heartbeats(double now, int32_t* newly_dead) {
+    std::lock_guard<std::mutex> g(mu_);
+    int32_t count = 0;
+    for (int32_t i = 0; i < (int32_t)alive_.size(); ++i) {
+      if (alive_[i] && now - last_hb_[i] > timeout_s_) {
+        alive_[i] = 0;
+        ++deaths_;
+        if (newly_dead) newly_dead[count] = i;
+        ++count;
+      }
+    }
+    return count;
+  }
+
+  // Per-job optimistic revival (server.c:222,278).
+  void revive_all(double now) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (size_t i = 0; i < alive_.size(); ++i) {
+      alive_[i] = 1;
+      last_hb_[i] = now;
+    }
+  }
+
+  int32_t death_count() {
+    std::lock_guard<std::mutex> g(mu_);
+    return deaths_;
+  }
+
+  int32_t live_count() {
+    std::lock_guard<std::mutex> g(mu_);
+    int32_t c = 0;
+    for (int32_t a : alive_) c += a;
+    return c;
+  }
+
+ private:
+  bool valid(int32_t w) const { return w >= 0 && w < (int32_t)alive_.size(); }
+
+  std::mutex mu_;
+  double timeout_s_;
+  std::vector<int32_t> alive_;
+  std::vector<double> last_hb_;
+  int32_t deaths_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void dsort_kway_merge_i32(const int32_t** runs, const int64_t* lens,
+                          int32_t nruns, int32_t* out) {
+  kway_merge<int32_t>(runs, lens, nruns, out);
+}
+
+void dsort_kway_merge_i64(const int64_t** runs, const int64_t* lens,
+                          int32_t nruns, int64_t* out) {
+  kway_merge<int64_t>(runs, lens, nruns, out);
+}
+
+void dsort_kway_merge_u64(const uint64_t** runs, const int64_t* lens,
+                          int32_t nruns, uint64_t* out) {
+  kway_merge<uint64_t>(runs, lens, nruns, out);
+}
+
+void dsort_kway_merge_kv_u64(const uint64_t** kruns, const uint8_t** vruns,
+                             const int64_t* lens, int32_t nruns, int32_t pbytes,
+                             uint64_t* out_k, uint8_t* out_v) {
+  kway_merge_kv<uint64_t>(kruns, vruns, lens, nruns, pbytes, out_k, out_v);
+}
+
+void dsort_kway_merge_kv_i64(const int64_t** kruns, const uint8_t** vruns,
+                             const int64_t* lens, int32_t nruns, int32_t pbytes,
+                             int64_t* out_k, uint8_t* out_v) {
+  kway_merge_kv<int64_t>(kruns, vruns, lens, nruns, pbytes, out_k, out_v);
+}
+
+void* dsort_table_create(int32_t n, double heartbeat_timeout_s) {
+  return new WorkerTable(n, heartbeat_timeout_s);
+}
+
+void dsort_table_destroy(void* t) { delete static_cast<WorkerTable*>(t); }
+
+void dsort_table_heartbeat(void* t, int32_t w, double now) {
+  static_cast<WorkerTable*>(t)->heartbeat(w, now);
+}
+
+int32_t dsort_table_is_alive(void* t, int32_t w) {
+  return static_cast<WorkerTable*>(t)->is_alive(w);
+}
+
+void dsort_table_mark_dead(void* t, int32_t w) {
+  static_cast<WorkerTable*>(t)->mark_dead(w);
+}
+
+int32_t dsort_table_first_live(void* t, int32_t exclude) {
+  return static_cast<WorkerTable*>(t)->first_live(exclude);
+}
+
+int32_t dsort_table_check_heartbeats(void* t, double now, int32_t* newly_dead) {
+  return static_cast<WorkerTable*>(t)->check_heartbeats(now, newly_dead);
+}
+
+void dsort_table_revive_all(void* t, double now) {
+  static_cast<WorkerTable*>(t)->revive_all(now);
+}
+
+int32_t dsort_table_death_count(void* t) {
+  return static_cast<WorkerTable*>(t)->death_count();
+}
+
+int32_t dsort_table_live_count(void* t) {
+  return static_cast<WorkerTable*>(t)->live_count();
+}
+
+}  // extern "C"

@@ -1,0 +1,136 @@
+"""Worker shim: joins a coordinator's cluster and serves sort tasks.
+
+The successor of the reference worker (``client.c``): connect to the master
+(``client.c:68-86``), loop receiving work, sort locally, send the result back
+(``client.c:90-137``).  Differences, by design:
+
+- frames are length-prefixed (u32 type | u32 task_id | u64 len) instead of
+  ``-1``-sentinel int32 pages, so no key value is reserved;
+- the local sort is a jitted JAX sort on the worker's accelerator (the
+  TPU-native replacement of the recursive mallocing merge sort at
+  ``client.c:140-173``); ``--backend numpy`` exists for light-weight tests;
+- a heartbeat thread reports liveness, so a hung worker is detectable
+  (the reference has no heartbeat at all, SURVEY.md §5.3).
+
+Run: ``python -m dsort_tpu.runtime.worker --host 127.0.0.1 --port 9008``
+(defaults match the reference's ``client.conf``; ``--conf client.conf``
+parses the reference's own file format).
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import struct
+import threading
+
+import numpy as np
+
+_HDR = struct.Struct("<IIQ")  # type, task_id, len — matches coordinator.cpp
+T_TASK, T_RESULT, T_HEARTBEAT, T_SHUTDOWN = 1, 2, 3, 4
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class SortWorker:
+    """One worker process: receive chunk -> local sort -> send back."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        dtype="int32",
+        backend: str = "jax",
+        heartbeat_interval_s: float = 1.0,
+    ):
+        self.host = host
+        self.port = port
+        self.dtype = np.dtype(dtype)
+        self.backend = backend
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self._sock: socket.socket | None = None
+        self._send_lock = threading.Lock()
+        self._stop = threading.Event()
+        if backend == "jax":
+            import jax
+
+            self._jit_sort = jax.jit(lambda x: jax.numpy.sort(x))
+        else:
+            self._jit_sort = None
+
+    def _sort(self, arr: np.ndarray) -> np.ndarray:
+        if self._jit_sort is not None:
+            return np.asarray(self._jit_sort(arr))
+        return np.sort(arr, kind="stable")
+
+    def _send_frame(self, ftype: int, task_id: int, payload: bytes = b"") -> None:
+        with self._send_lock:
+            self._sock.sendall(_HDR.pack(ftype, task_id, len(payload)))
+            if payload:
+                self._sock.sendall(payload)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self._send_frame(T_HEARTBEAT, 0)
+            except OSError:
+                return
+
+    def serve_forever(self) -> None:
+        self._sock = socket.create_connection((self.host, self.port))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        hb.start()
+        try:
+            while True:
+                hdr = _read_exact(self._sock, _HDR.size)
+                if hdr is None:
+                    return  # server closed (client.c:97-100 analogue)
+                ftype, task_id, length = _HDR.unpack(hdr)
+                if ftype == T_SHUTDOWN:
+                    return
+                if ftype != T_TASK:
+                    continue
+                payload = _read_exact(self._sock, length) if length else b""
+                if payload is None:
+                    return
+                arr = np.frombuffer(payload, dtype=self.dtype)
+                out = self._sort(arr)
+                self._send_frame(T_RESULT, task_id, out.tobytes())
+        finally:
+            self._stop.set()
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="dsort_tpu worker shim")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9008)  # client.conf default
+    ap.add_argument("--conf", help="reference-format client.conf (SERVER_IP/SERVER_PORT)")
+    ap.add_argument("--dtype", default="int32")
+    ap.add_argument("--backend", choices=["jax", "numpy"], default="jax")
+    args = ap.parse_args(argv)
+    host, port = args.host, args.port
+    if args.conf:
+        from dsort_tpu.config import load_conf_file
+
+        conf = load_conf_file(args.conf)
+        host = conf.get("SERVER_IP", host)
+        port = int(conf.get("SERVER_PORT", port))
+    SortWorker(host, port, dtype=args.dtype, backend=args.backend).serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,146 @@
+"""Native C++ runtime tests: k-way merge parity, worker table, and a real
+multi-process coordinator cluster with an injected worker kill (the SURVEY.md
+§0 experiment, natively)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dsort_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint64])
+def test_native_kway_merge_parity(dtype):
+    rng = np.random.default_rng(1)
+    info = np.iinfo(dtype)
+    runs = [
+        np.sort(rng.integers(info.min, info.max, n, dtype=dtype))
+        for n in (0, 17, 1000, 3, 4096)
+    ]
+    out = native.kway_merge(runs)
+    np.testing.assert_array_equal(out, np.sort(np.concatenate(runs)))
+
+
+def test_native_kway_merge_kv():
+    rng = np.random.default_rng(2)
+    key_runs, val_runs = [], []
+    for n in (50, 0, 200):
+        k = np.sort(rng.integers(0, 1000, n).astype(np.uint64))
+        v = rng.integers(0, 255, (n, 90)).astype(np.uint8)
+        # payloads must follow their keys: make payload derivable from key
+        v[:, 0] = (k % 251).astype(np.uint8)
+        key_runs.append(k)
+        val_runs.append(v)
+    ok, ov = native.kway_merge_kv(key_runs, val_runs)
+    np.testing.assert_array_equal(ok, np.sort(np.concatenate(key_runs)))
+    np.testing.assert_array_equal(ov[:, 0], (ok % 251).astype(np.uint8))
+
+
+def test_native_worker_table_semantics():
+    t = native.NativeWorkerTable(4, heartbeat_timeout_s=0.2)
+    assert t.first_live() == 0
+    t.mark_dead(0)
+    t.mark_dead(2)
+    assert t.first_live() == 1
+    assert t.first_live(exclude=1) == 3
+    assert t.live_workers() == [1, 3]
+    assert t.death_count == 2
+    # heartbeat lapse
+    time.sleep(0.3)
+    t.heartbeat(1)
+    newly = t.check_heartbeats()
+    assert newly == [3]
+    assert t.live_workers() == [1]
+    t.revive_all()
+    assert t.live_workers() == [0, 1, 2, 3]
+
+
+def _spawn_workers(port, n, dtype="int32"):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO  # drop the jax-preloading site hook for shims
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "dsort_tpu.runtime.worker",
+                "--port", str(port), "--backend", "numpy", "--dtype", dtype,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        for _ in range(n)
+    ]
+    return procs
+
+
+@pytest.fixture
+def cluster():
+    from dsort_tpu.runtime import NativeCoordinator
+
+    coord = NativeCoordinator(port=0, heartbeat_timeout_s=5.0)
+    procs = _spawn_workers(coord.port, 4)
+    try:
+        coord.wait_workers(4, timeout_s=30.0)
+        yield coord, procs
+    finally:
+        coord.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_coordinator_healthy_job(cluster):
+    coord, _ = cluster
+    data = np.random.default_rng(3).integers(-(2**31), 2**31 - 1, 40_000).astype(np.int32)
+    out = coord.run_job(data, num_shards=4)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert coord.num_live == 4
+
+
+def test_coordinator_worker_killed_midjob(cluster):
+    # The reference experiment: kill -9 one worker; job completes via
+    # reassignment to a live worker.
+    coord, procs = cluster
+    procs[1].kill()  # actual process kill, like SURVEY.md §0
+    time.sleep(0.2)
+    data = np.random.default_rng(4).integers(-(2**31), 2**31 - 1, 20_000).astype(np.int32)
+    out = coord.run_job(data, num_shards=4)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert coord.num_live == 3
+
+
+def test_coordinator_socket_kill_fault_injection(cluster):
+    coord, _ = cluster
+    coord.kill_worker(2)  # injector path: hard-close the socket
+    time.sleep(0.2)
+    data = np.random.default_rng(5).integers(0, 10**6, 10_000).astype(np.int32)
+    out = coord.run_job(data, num_shards=4)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert coord.num_live == 3
+
+
+def test_coordinator_all_workers_dead_fails_cleanly(cluster):
+    from dsort_tpu.scheduler.fault import JobFailedError
+
+    coord, procs = cluster
+    for p in procs:
+        p.kill()
+    time.sleep(0.5)
+    data = np.arange(100, dtype=np.int32)[::-1].copy()
+    with pytest.raises((JobFailedError, TimeoutError)):
+        coord.run_job(data, num_shards=4)
+    # Coordinator object survives for the next job/cluster (server.c:265-268).
+    assert coord.num_live == 0
