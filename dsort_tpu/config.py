@@ -92,6 +92,7 @@ class JobConfig:
 
     key_dtype: Any = jnp.int32
     payload_bytes: int = 0          # 0 → key-only sort; >0 → TeraSort-style records
+    local_kernel: str = "lax"       # per-chip sort: "lax" | "bitonic" | "pallas"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
     capacity_factor: float = 2.0    # per-(src,dst) all_to_all bucket headroom
@@ -112,6 +113,12 @@ class JobConfig:
             )
         if self.payload_bytes < 0:
             raise ConfigError(f"payload_bytes must be >= 0, got {self.payload_bytes}")
+        from dsort_tpu.ops.local_sort import LOCAL_KERNELS
+
+        if self.local_kernel not in LOCAL_KERNELS:
+            raise ConfigError(
+                f"local_kernel must be one of {LOCAL_KERNELS}, got {self.local_kernel!r}"
+            )
         if self.oversample < 1:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")
         if self.capacity_factor < 1.0:
@@ -148,6 +155,7 @@ class SortConfig:
         job = JobConfig(
             key_dtype=jnp.dtype(m.get("KEY_DTYPE", "int32")),
             payload_bytes=geti("PAYLOAD_BYTES", 0),
+            local_kernel=m.get("LOCAL_KERNEL", "lax"),
             oversample=geti("OVERSAMPLE", 32),
             capacity_factor=float(m.get("CAPACITY_FACTOR", 2.0)),
             heartbeat_timeout_s=float(m.get("HEARTBEAT_TIMEOUT_S", 10.0)),

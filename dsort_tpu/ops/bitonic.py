@@ -1,0 +1,105 @@
+"""Vectorized bitonic sorting network in pure jnp (L0 alternative kernel).
+
+A data-oblivious O(N log^2 N) network: every compare-exchange pass is a
+reshape + min/max/where over the whole array — no gathers, no data-dependent
+control flow — which XLA maps straight onto the VPU.  This is the TPU-native
+answer to the reference's recursive, per-merge-mallocing CPU merge sort
+(``client.c:140-173``): same job (sort one worker's chunk), but as a fixed
+compiled dataflow instead of pointer-chasing recursion.
+
+The XOR-partner trick: for exchange distance ``j`` (a power of two), pairs
+``(i, i^j)`` are adjacent along the middle axis of a ``(N/2j, 2, j)`` view of
+the array, so a whole pass is two slices, elementwise min/max, and a
+direction mask derived from index bits.
+
+Used directly as a jittable sort, as the in-kernel network of the Pallas tile
+sort (``ops.pallas_sort``), and as a reference implementation for tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dsort_tpu.ops.local_sort import sentinel_for
+
+
+def _ceil_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _pass(x: jax.Array, k: int, j: int) -> jax.Array:
+    """One compare-exchange pass: stage size k, partner distance j."""
+    n = x.shape[0]
+    v = x.reshape(n // (2 * j), 2, j)
+    a, b = v[:, 0, :], v[:, 1, :]
+    lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+    # Ascending iff bit log2(k) of the flat index is 0.  Within row m of the
+    # (n/2j, 2, j) view the flat index is m*2j + s*j + t with k >= 2j, so the
+    # bit is carried entirely by m.
+    m = jax.lax.broadcasted_iota(jnp.int32, (n // (2 * j), 1), 0)
+    asc = (m * (2 * j)) & k == 0
+    out = jnp.stack(
+        [jnp.where(asc, lo, hi), jnp.where(asc, hi, lo)], axis=1
+    )
+    return out.reshape(n)
+
+
+def bitonic_sort(x: jax.Array) -> jax.Array:
+    """Ascending sort of a 1-D array via the full bitonic network.
+
+    Non-power-of-two lengths are padded with the dtype sentinel and trimmed,
+    so the result equals ``jnp.sort(x)`` for every length.
+    """
+    n = x.shape[0]
+    if n <= 1:
+        return x
+    p = _ceil_pow2(n)
+    padded = x
+    if p != n:
+        padded = jnp.concatenate(
+            [x, jnp.full(p - n, sentinel_for(x.dtype), dtype=x.dtype)]
+        )
+    k = 2
+    while k <= p:
+        j = k // 2
+        while j >= 1:
+            padded = _pass(padded, k, j)
+            j //= 2
+        k *= 2
+    return padded[:n]
+
+
+def bitonic_merge_pair(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Merge two sorted equal-length arrays into one sorted array, O(N log N).
+
+    Reversing ``b`` makes ``[a, reversed(b)]`` bitonic; the merge half of the
+    network (distances N/2 .. 1, all ascending) finishes the job.  This is
+    the on-device pairwise merge primitive (cheaper than re-sorting the
+    concatenation) used to combine sorted runs.
+    """
+    n = a.shape[0]
+    assert b.shape[0] == n, "bitonic_merge_pair needs equal-length runs"
+    x = jnp.concatenate([a, b[::-1]])
+    total = 2 * n
+    j = total // 2
+    while j >= 1:
+        v = x.reshape(total // (2 * j), 2, j)
+        lo = jnp.minimum(v[:, 0, :], v[:, 1, :])
+        hi = jnp.maximum(v[:, 0, :], v[:, 1, :])
+        x = jnp.stack([lo, hi], axis=1).reshape(total)
+        j //= 2
+    return x
+
+
+def merge_sorted_runs(runs: jax.Array) -> jax.Array:
+    """Merge ``(R, n)`` sorted rows (R a power of two) into one sorted row
+    by a log2(R)-deep tree of `bitonic_merge_pair` calls."""
+    r = runs.shape[0]
+    while r > 1:
+        runs = jax.vmap(bitonic_merge_pair)(runs[0::2], runs[1::2])
+        r //= 2
+    return runs[0]

@@ -61,18 +61,42 @@ def sort_kv(keys: jax.Array, payload: jax.Array) -> tuple[jax.Array, jax.Array]:
     return out_k, _apply_perm(payload, perm, keys.ndim - 1)
 
 
+LOCAL_KERNELS = ("lax", "bitonic", "pallas")
+
+
+def sort_with_kernel(keys: jax.Array, kernel: str = "lax") -> jax.Array:
+    """Dispatch a 1-D ascending sort to one of the local kernel families.
+
+    - ``lax``: XLA's built-in sort (the default; best all-round on TPU);
+    - ``bitonic``: the pure-jnp vectorized bitonic network (``ops.bitonic``);
+    - ``pallas``: the Pallas VMEM tile-sort kernel (``ops.pallas_sort``).
+    """
+    if kernel == "lax":
+        return jnp.sort(keys, axis=-1)
+    if kernel == "bitonic":
+        from dsort_tpu.ops.bitonic import bitonic_sort
+
+        return bitonic_sort(keys)
+    if kernel == "pallas":
+        from dsort_tpu.ops.pallas_sort import pallas_sort
+
+        return pallas_sort(keys)
+    raise ValueError(f"unknown local kernel {kernel!r}; options: {LOCAL_KERNELS}")
+
+
 def sort_padded(
-    keys: jax.Array, count: jax.Array | int
+    keys: jax.Array, count: jax.Array | int, kernel: str = "lax"
 ) -> tuple[jax.Array, jax.Array]:
     """Sort a fixed-size buffer whose first ``count`` entries are valid.
 
     Entries at positions >= ``count`` are overwritten with the sentinel before
     sorting, so the result is ``(sorted buffer with pads at the tail, count)``.
     """
-    n = keys.shape[-1]
     pos = jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1)
     masked = jnp.where(pos < count, keys, sentinel_for(keys.dtype))
-    return jnp.sort(masked, axis=-1), jnp.asarray(count, jnp.int32)
+    if kernel == "lax":
+        return jnp.sort(masked, axis=-1), jnp.asarray(count, jnp.int32)
+    return sort_with_kernel(masked, kernel), jnp.asarray(count, jnp.int32)
 
 
 def sort_kv_padded(

@@ -79,7 +79,9 @@ def _bucket_slices(xs_sorted, count, splitters, cap_pair: int):
     return jnp.clip(gidx, 0, max(n_local - 1, 0)), valid, lens, overflow
 
 
-def _sample_sort_shard(xs, count, *, num_workers, oversample, cap_pair, axis):
+def _sample_sort_shard(
+    xs, count, *, num_workers, oversample, cap_pair, axis, kernel="lax"
+):
     """One device's view of the whole distributed sort (runs under shard_map).
 
     ``xs``: (n_local,) sentinel-padded keys; ``count``: (1,) valid length.
@@ -87,7 +89,7 @@ def _sample_sort_shard(xs, count, *, num_workers, oversample, cap_pair, axis):
     """
     sent = sentinel_for(xs.dtype)
     count = count[0]
-    xs, _ = sort_padded(xs, count)                                   # phase 1
+    xs, _ = sort_padded(xs, count, kernel)                           # phase 1
     splitters = _choose_splitters(xs, count, num_workers, oversample, axis)  # 2
     gidx, valid, lens, overflow = _bucket_slices(xs, count, splitters, cap_pair)  # 3
     send = jnp.where(valid, xs[gidx], sent)
@@ -151,7 +153,9 @@ class SampleSort:
             axis=self.axis,
         )
         if kv_trailing is None:
-            fn = functools.partial(_sample_sort_shard, **kwargs)
+            fn = functools.partial(
+                _sample_sort_shard, kernel=self.job.local_kernel, **kwargs
+            )
             in_specs = (P(self.axis), P(self.axis))
             out_specs = (P(self.axis), P(self.axis), P(self.axis))
         else:

@@ -1,0 +1,85 @@
+"""Bitonic network + Pallas tile-sort kernel tests (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsort_tpu.config import ConfigError, JobConfig
+from dsort_tpu.ops.bitonic import bitonic_merge_pair, bitonic_sort, merge_sorted_runs
+from dsort_tpu.ops.local_sort import sort_with_kernel
+from dsort_tpu.ops.pallas_sort import pallas_sort
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 127, 128, 1000, 4096])
+def test_bitonic_sort_matches_numpy(n):
+    rng = np.random.default_rng(n)
+    x = rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32)
+    y = np.asarray(jax.jit(bitonic_sort)(jnp.asarray(x)))
+    np.testing.assert_array_equal(y, np.sort(x))
+
+
+def test_bitonic_sort_dtypes():
+    rng = np.random.default_rng(0)
+    for dtype in (np.int64, np.uint64, np.float32):
+        if np.issubdtype(dtype, np.floating):
+            x = rng.standard_normal(512).astype(dtype)
+        else:
+            x = rng.integers(0, 2**60, 512).astype(dtype)
+        np.testing.assert_array_equal(np.asarray(bitonic_sort(jnp.asarray(x))), np.sort(x))
+
+
+def test_bitonic_merge_pair():
+    rng = np.random.default_rng(2)
+    a = np.sort(rng.integers(0, 10**6, 1024).astype(np.int32))
+    b = np.sort(rng.integers(0, 10**6, 1024).astype(np.int32))
+    out = np.asarray(bitonic_merge_pair(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_array_equal(out, np.sort(np.concatenate([a, b])))
+
+
+def test_merge_sorted_runs_tree():
+    rng = np.random.default_rng(3)
+    runs = np.sort(rng.integers(-(10**6), 10**6, (4, 256)).astype(np.int32), axis=1)
+    out = np.asarray(merge_sorted_runs(jnp.asarray(runs)))
+    np.testing.assert_array_equal(out, np.sort(runs.reshape(-1)))
+
+
+@pytest.mark.parametrize("n,rows", [(1024, 8), (3 * 1024 + 17, 8)])
+def test_pallas_sort_matches_numpy(n, rows):
+    rng = np.random.default_rng(n)
+    x = rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32)
+    y = np.asarray(pallas_sort(jnp.asarray(x), tile_rows=rows))
+    np.testing.assert_array_equal(y, np.sort(x))
+
+
+def test_sort_with_kernel_dispatch():
+    x = jnp.asarray(np.array([5, -3, 7, 0], dtype=np.int32))
+    for kernel in ("lax", "bitonic"):
+        np.testing.assert_array_equal(
+            np.asarray(sort_with_kernel(x, kernel)), [-3, 0, 5, 7]
+        )
+    with pytest.raises(ValueError, match="unknown local kernel"):
+        sort_with_kernel(x, "quicksort")
+
+
+def test_job_config_validates_kernel():
+    with pytest.raises(ConfigError, match="local_kernel"):
+        JobConfig(local_kernel="bogus")
+
+
+def test_sample_sort_with_bitonic_kernel(mesh8):
+    from dsort_tpu.data.ingest import gen_uniform
+    from dsort_tpu.parallel.sample_sort import SampleSort
+
+    data = gen_uniform(20_000, seed=21)
+    out = SampleSort(mesh8, JobConfig(local_kernel="bitonic")).sort(data)
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_sample_sort_with_pallas_kernel(mesh8):
+    from dsort_tpu.data.ingest import gen_uniform
+    from dsort_tpu.parallel.sample_sort import SampleSort
+
+    data = gen_uniform(2_048, seed=22)
+    out = SampleSort(mesh8, JobConfig(local_kernel="pallas")).sort(data)
+    np.testing.assert_array_equal(out, np.sort(data))
