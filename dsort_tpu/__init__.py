@@ -8,24 +8,29 @@ merge sort over TCP sockets with reassign-on-failure; see /root/reference,
 - the worker's local recursive merge sort (``client.c:140-173``) becomes a
   per-chip jitted sort (``ops.local_sort``);
 - the master's socket scatter + centralized O(N*k) merge (``server.c:342-456,
-  481-524``) becomes per-device sorts plus an on-mesh combine
-  (``models.pipelines``; the all_to_all sample-sort shuffle lands in
-  ``parallel.sample_sort``);
+  481-524``) becomes sample-sort splitters + an ``all_to_all`` shuffle over
+  the mesh with per-chip merges (``parallel.sample_sort``), plus a
+  gather-merge pipeline mirroring the reference shape (``models.pipelines``);
 - the fixed 4-worker TCP star (``server.c:120-157``) becomes a
-  ``jax.sharding.Mesh`` built from typed config (``config``, ``parallel.mesh``);
+  ``jax.sharding.Mesh`` built from typed config (``config``, ``parallel.mesh``),
+  and for cross-host clusters a native C++ framed-TCP coordinator with
+  Python/JAX worker shims (``runtime``);
 - the reassign-on-failure scheduler (``server.c:297-477``) becomes a
   liveness-tracking scheduler with heartbeats (fixing the reference's
   hang-blindness), whole-shard retry on a live device, result-slot pinning,
-  and clean job failure when no devices remain (``scheduler`` package).
+  clean job failure when no devices remain, and sorted-shard checkpointing
+  for partial recovery (``scheduler``, ``checkpoint``).
 
-Package layout (modules marked * are being landed incrementally this cycle):
-  models/    sort pipelines (the "model zoo": local, gather-merge, sample-sort*)
-  ops/       per-chip compute kernels (lax.sort wrappers; bitonic*, Pallas*)
-  parallel/  mesh construction + SPMD collectives (shard_map / all_to_all)
-  scheduler/ * job driver, liveness, fault tolerance, fault injection
-  data/      ingest/egress + synthetic generators (uniform, zipf, terasort)
-  runtime/   * native C++ runtime bindings (k-way merge, worker table, coordinator)
-  utils/     structured logging, metrics, tracing
+Package layout:
+  models/     sort pipelines (the "model zoo": local, gather-merge, sample-sort)
+  ops/        per-chip kernels (lax.sort wrappers, bitonic network, Pallas tile sort)
+  parallel/   mesh construction + SPMD collectives (shard_map / all_to_all)
+  scheduler/  job driver, liveness, fault tolerance, fault injection
+  data/       ingest/egress + synthetic generators (uniform, zipf, terasort)
+  runtime/    native C++ runtime (k-way merge, worker table, TCP coordinator)
+  utils/      structured logging, metrics, profiling hooks
+  checkpoint  sorted-shard persistence for partial recovery
+  cli         dsort run/serve/bench/gen/coordinator/worker
 """
 
 __version__ = "0.1.0"

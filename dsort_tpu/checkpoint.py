@@ -1,0 +1,75 @@
+"""Sorted-shard checkpointing for partial recovery (SURVEY.md §5.4 upgrade).
+
+The reference has no checkpointing: a failed exchange restarts the whole
+chunk (``offset = 0``, ``server.c:381,436``) and a failed job is re-entered
+from scratch at the REPL.  Here each shard's sorted result can be persisted
+as it completes, so a re-run of the same job (after failures, or after the
+SPMD path re-forms a smaller mesh) skips shards that already finished —
+strictly better than restart-the-chunk.
+
+Format: one ``.npy`` per shard under ``<dir>/<job_id>/`` plus a manifest
+recording shard count and dtype; plain numpy IO keeps recovery dependency-
+free (orbax remains available for array-tree checkpoints elsewhere).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+
+class ShardCheckpoint:
+    """Per-job shard result store keyed by (checkpoint_dir, job_id)."""
+
+    def __init__(self, root: str, job_id: str):
+        if not job_id or "/" in job_id:
+            raise ValueError(f"invalid job_id {job_id!r}")
+        self.dir = os.path.join(root, job_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self._manifest_path = os.path.join(self.dir, "manifest.json")
+
+    def _shard_path(self, shard_id: int) -> str:
+        return os.path.join(self.dir, f"shard_{shard_id:05d}.npy")
+
+    def write_manifest(self, num_shards: int, dtype, total: int) -> None:
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {"num_shards": num_shards, "dtype": str(np.dtype(dtype)), "total": total},
+                f,
+            )
+        os.replace(tmp, self._manifest_path)
+
+    def manifest(self) -> dict | None:
+        try:
+            with open(self._manifest_path, encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def has(self, shard_id: int) -> bool:
+        return os.path.exists(self._shard_path(shard_id))
+
+    def save(self, shard_id: int, arr: np.ndarray) -> None:
+        # Write-then-rename so a crash mid-save never yields a torn shard.
+        path = self._shard_path(shard_id)
+        tmp = path + ".tmp.npy"
+        np.save(tmp, np.asarray(arr))
+        os.replace(tmp, path)
+
+    def load(self, shard_id: int) -> np.ndarray:
+        return np.load(self._shard_path(shard_id))
+
+    def completed_shards(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("shard_") and name.endswith(".npy"):
+                out.append(int(name[len("shard_"):-len(".npy")]))
+        return sorted(out)
+
+    def clear(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+        os.makedirs(self.dir, exist_ok=True)

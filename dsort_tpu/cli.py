@@ -1,0 +1,269 @@
+"""Command-line interface (L6 of SURVEY.md's layer map).
+
+The reference's entire user surface is a stdin REPL on the master ("type a
+filename, get output.txt", ``server.c:160-167``) plus conf-file argv
+(``server.c:100-103``).  The CLI keeps that workflow (`dsort serve` is the
+REPL; conf files in the reference's own format are accepted) and adds the
+one-shot, benchmark, data-generation, cluster, and worker entry points a real
+tool needs.
+
+  dsort run INPUT [-o OUT]      one sort job (file -> file)
+  dsort serve                   REPL: filenames on stdin until 'exit'
+  dsort bench                   throughput benchmark, one JSON line
+  dsort gen N -o FILE           synthetic inputs (uniform / zipf)
+  dsort coordinator             native TCP coordinator for a worker cluster
+  dsort worker                  worker shim joining a coordinator
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from dsort_tpu.config import SortConfig
+from dsort_tpu.utils.logging import get_logger
+from dsort_tpu.utils.metrics import Metrics
+
+log = get_logger("cli")
+
+
+def _load_config(args) -> SortConfig:
+    cfg = SortConfig.from_conf_file(args.conf) if args.conf else SortConfig()
+    overrides = {}
+    if getattr(args, "workers", None):
+        overrides["NUM_WORKERS"] = str(args.workers)
+    if getattr(args, "dtype", None):
+        overrides["KEY_DTYPE"] = args.dtype
+    if getattr(args, "kernel", None):
+        overrides["LOCAL_KERNEL"] = args.kernel
+    if overrides:
+        base = {
+            "SERVER_IP": cfg.server_ip,
+            "SERVER_PORT": str(cfg.server_port),
+            "KEY_DTYPE": str(np.dtype(cfg.job.key_dtype)),
+            "LOCAL_KERNEL": cfg.job.local_kernel,
+        }
+        if cfg.mesh.num_workers is not None:
+            base["NUM_WORKERS"] = str(cfg.mesh.num_workers)
+        base.update(overrides)
+        cfg = SortConfig.from_mapping(base)
+    return cfg
+
+
+def _make_sorter(cfg: SortConfig, mode: str):
+    """Build the sort callable for one of the execution modes."""
+    if mode == "spmd":
+        from dsort_tpu.scheduler import SpmdScheduler
+
+        import jax
+
+        devs = jax.devices()
+        n = cfg.mesh.num_workers or len(devs)
+        sched = SpmdScheduler(devices=devs[:n], job=cfg.job)
+        return lambda data, metrics: sched.sort(data, metrics=metrics)
+    if mode == "taskpool":
+        from dsort_tpu.scheduler import DeviceExecutor, Scheduler
+
+        import jax
+
+        devs = jax.devices()
+        n = cfg.mesh.num_workers or len(devs)
+        sched = Scheduler(DeviceExecutor(devices=devs[:n]), cfg.job)
+        return lambda data, metrics: sched.run_job(data, metrics=metrics)
+    if mode == "local":
+        import jax
+
+        f = jax.jit(lambda x: jax.numpy.sort(x))
+        return lambda data, metrics: np.asarray(f(data))
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+def _run_one(sorter, in_path: str, out_path: str, dtype) -> None:
+    from dsort_tpu.data.ingest import read_ints_file, write_ints_file
+
+    t0 = time.perf_counter()
+    data = read_ints_file(in_path, dtype=dtype)
+    metrics = Metrics()
+    out = sorter(data, metrics)
+    write_ints_file(out_path, out)
+    dt = time.perf_counter() - t0
+    log.info(
+        "sorted %d keys in %.1f ms (%s) -> %s | phases: %s",
+        len(data), dt * 1e3, in_path, out_path, metrics.summary()["phases_ms"],
+    )
+
+
+def cmd_run(args) -> int:
+    cfg = _load_config(args)
+    sorter = _make_sorter(cfg, args.mode)
+    _run_one(sorter, args.input, args.output or cfg.output_path, np.dtype(cfg.job.key_dtype))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """The reference's interactive job loop (server.c:160-167 workflow)."""
+    cfg = _load_config(args)
+    sorter = _make_sorter(cfg, args.mode)
+    dtype = np.dtype(cfg.job.key_dtype)
+    while True:
+        try:
+            line = input("Enter the filename to sort (or 'exit' to quit): ")
+        except EOFError:
+            return 0
+        name = line.strip()
+        if not name:
+            continue
+        if name == "exit":
+            return 0
+        try:
+            _run_one(sorter, name, args.output or cfg.output_path, dtype)
+        except Exception as e:  # a bad job must not kill the server
+            log.error("job failed: %s", e)
+
+
+def cmd_bench(args) -> int:
+    from dsort_tpu.data.ingest import gen_uniform
+
+    cfg = _load_config(args)
+    sorter = _make_sorter(cfg, args.mode)
+    data = gen_uniform(args.n, dtype=np.dtype(cfg.job.key_dtype), seed=0)
+    sorter(data, Metrics())  # warm/compile
+    times = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        sorter(data, Metrics())
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    ref = 16_384 / 0.374  # BASELINE.md measured reference throughput
+    print(
+        json.dumps(
+            {
+                "metric": f"sort_throughput_{np.dtype(cfg.job.key_dtype)}_{args.n}_keys_{args.mode}",
+                "value": round(args.n / dt, 1),
+                "unit": "keys/sec",
+                "vs_baseline": round(args.n / dt / ref, 2),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_gen(args) -> int:
+    from dsort_tpu.data.ingest import gen_uniform, gen_zipf, write_ints_file
+
+    if args.dist == "uniform":
+        data = gen_uniform(args.n, dtype=np.dtype(args.dtype), seed=args.seed)
+    else:
+        data = gen_zipf(args.n, a=args.zipf_a, seed=args.seed)
+    write_ints_file(args.output, data)
+    log.info("wrote %d %s keys (%s) to %s", args.n, args.dtype, args.dist, args.output)
+    return 0
+
+
+def cmd_coordinator(args) -> int:
+    """Run the native coordinator and serve REPL jobs over the cluster."""
+    from dsort_tpu.runtime import NativeCoordinator
+    from dsort_tpu.data.ingest import read_ints_file, write_ints_file
+
+    cfg = _load_config(args)
+    dtype = np.dtype(cfg.job.key_dtype)
+    nworkers = args.workers or 4
+    with NativeCoordinator(
+        port=args.port if args.port is not None else cfg.server_port,
+        heartbeat_timeout_s=cfg.job.heartbeat_timeout_s,
+    ) as coord:
+        log.info("coordinator listening on port %d", coord.port)
+        coord.wait_workers(nworkers, timeout_s=args.join_timeout)
+        log.info("%d workers joined", nworkers)
+        while True:
+            try:
+                line = input("Enter the filename to sort (or 'exit' to quit): ")
+            except EOFError:
+                return 0
+            name = line.strip()
+            if name == "exit" or not name:
+                if name == "exit":
+                    return 0
+                continue
+            try:
+                data = read_ints_file(name, dtype=dtype)
+                metrics = Metrics()
+                out = coord.run_job(data, num_shards=nworkers, metrics=metrics)
+                write_ints_file(args.output or cfg.output_path, out)
+                log.info(
+                    "sorted %d keys | live workers %d | reassignments %d",
+                    len(data), coord.num_live, coord.reassignments,
+                )
+            except Exception as e:
+                log.error("job failed: %s", e)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dsort", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p, mode_default="spmd"):
+        p.add_argument("--conf", help="KEY=value conf file (reference format accepted)")
+        p.add_argument("--mode", default=mode_default,
+                       choices=["spmd", "taskpool", "local"])
+        p.add_argument("--workers", type=int)
+        p.add_argument("--dtype")
+        p.add_argument("--kernel", choices=["lax", "bitonic", "pallas"])
+        p.add_argument("-o", "--output")
+
+    p = sub.add_parser("run", help="sort one file")
+    p.add_argument("input")
+    common(p)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("serve", help="interactive job loop (reference REPL)")
+    common(p)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("bench", help="throughput benchmark (one JSON line)")
+    common(p)
+    p.add_argument("--n", type=int, default=1 << 22)
+    p.add_argument("--reps", type=int, default=3)
+    p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser("gen", help="generate synthetic input files")
+    p.add_argument("n", type=int)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--dist", default="uniform", choices=["uniform", "zipf"])
+    p.add_argument("--dtype", default="int32")
+    p.add_argument("--zipf-a", type=float, default=1.3)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_gen)
+
+    p = sub.add_parser("coordinator", help="native TCP coordinator + job REPL")
+    common(p)  # provides --workers (cluster size; default 4 below)
+    p.add_argument("--port", type=int)
+    p.add_argument("--join-timeout", type=float, default=60.0)
+    p.set_defaults(fn=cmd_coordinator)
+
+    p = sub.add_parser("worker", help="worker shim (joins a coordinator)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9008)
+    p.add_argument("--conf")
+    p.add_argument("--dtype", default="int32")
+    p.add_argument("--backend", choices=["jax", "numpy"], default="jax")
+    p.set_defaults(fn=None)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "worker":
+        from dsort_tpu.runtime.worker import main as worker_main
+
+        wargs = ["--host", args.host, "--port", str(args.port),
+                 "--dtype", args.dtype, "--backend", args.backend]
+        if args.conf:
+            wargs += ["--conf", args.conf]
+        return worker_main(wargs)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

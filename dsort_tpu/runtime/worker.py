@@ -50,12 +50,14 @@ class SortWorker:
         dtype="int32",
         backend: str = "jax",
         heartbeat_interval_s: float = 1.0,
+        connect_timeout_s: float = 30.0,
     ):
         self.host = host
         self.port = port
         self.dtype = np.dtype(dtype)
         self.backend = backend
         self.heartbeat_interval_s = heartbeat_interval_s
+        self.connect_timeout_s = connect_timeout_s
         self._sock: socket.socket | None = None
         self._send_lock = threading.Lock()
         self._stop = threading.Event()
@@ -84,8 +86,23 @@ class SortWorker:
             except OSError:
                 return
 
+    def _connect_with_retry(self) -> socket.socket:
+        # The reference client exits on a failed connect (client.c:82-86);
+        # retrying makes cluster formation order-independent.
+        import time
+
+        deadline = time.monotonic() + self.connect_timeout_s
+        while True:
+            try:
+                return socket.create_connection((self.host, self.port), timeout=5.0)
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.3)
+
     def serve_forever(self) -> None:
-        self._sock = socket.create_connection((self.host, self.port))
+        self._sock = self._connect_with_retry()
+        self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
         hb.start()

@@ -124,9 +124,15 @@ class Scheduler:
         return box["r"]
 
     def _handle_shard(
-        self, i: int, shard: np.ndarray, results: list, metrics: Metrics
+        self, i: int, shard: np.ndarray, results: list, metrics: Metrics, ckpt=None
     ) -> None:
         """One shard's lifecycle: the worker_handler attempt loop."""
+        if ckpt is not None and ckpt.has(i):
+            # Partial recovery (§5.4 upgrade): this shard already completed in
+            # an earlier run of the same job — skip the sort entirely.
+            results[i] = ckpt.load(i)
+            metrics.bump("shards_restored")
+            return
         worker = i if self.table.is_alive(i) else -1
         while True:
             if worker < 0 or not self.table.is_alive(worker):
@@ -135,6 +141,8 @@ class Scheduler:
                     return  # clean abort; job-level gate raises
             try:
                 results[i] = self._attempt(worker, shard)
+                if ckpt is not None:
+                    ckpt.save(i, results[i])
                 return  # result pinned to slot i (server.c:415)
             except (WorkerFailure, TimeoutError) as e:
                 stage = getattr(e, "stage", "timeout")
@@ -153,23 +161,38 @@ class Scheduler:
                 time.sleep(self.job.settle_delay_s)  # server.c:304,391,446
                 worker = nxt
 
-    def run_job(self, data: np.ndarray, metrics: Metrics | None = None) -> np.ndarray:
+    def run_job(
+        self,
+        data: np.ndarray,
+        metrics: Metrics | None = None,
+        job_id: str | None = None,
+    ) -> np.ndarray:
         """One sort job: partition → dispatch → (reassign) → merge.
 
         Raises `JobFailedError` if any shard could not complete (all workers
-        dead); the scheduler itself remains usable for the next job.
+        dead); the scheduler itself remains usable for the next job.  With
+        ``job.checkpoint_dir`` set and a ``job_id`` given, completed shards
+        persist across runs, so re-running a failed job re-sorts only the
+        shards that were lost (§5.4 upgrade over restart-the-chunk).
         """
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         w = self.executor.num_workers
         self.table.revive_all()  # server.c:222,278
+        ckpt = None
+        if self.job.checkpoint_dir and job_id:
+            from dsort_tpu.checkpoint import ShardCheckpoint
+
+            ckpt = ShardCheckpoint(self.job.checkpoint_dir, job_id)
+            ckpt.write_manifest(w, np.asarray(data).dtype, len(data))
         with timer.phase("partition"):
             shards = partition(np.asarray(data), w)
         results: list[np.ndarray | None] = [None] * w
         with timer.phase("dispatch"):
             threads = [
                 threading.Thread(
-                    target=self._handle_shard, args=(i, shards[i], results, metrics)
+                    target=self._handle_shard,
+                    args=(i, shards[i], results, metrics, ckpt),
                 )
                 for i in range(w)
             ]

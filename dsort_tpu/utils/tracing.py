@@ -1,0 +1,34 @@
+"""Profiling hooks (SURVEY.md §5.1 upgrade — the reference has none).
+
+Wraps ``jax.profiler``: `profile_trace` captures a TensorBoard/Perfetto trace
+of a region, `annotate` labels host-side phases so they show up alongside
+device ops.  No-ops cleanly if profiling is unavailable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str | None):
+    """Capture a jax.profiler trace into ``logdir`` (None → no-op)."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Label a host-side region in profiler timelines (no-op off-profile)."""
+    try:
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:
+        yield
