@@ -153,14 +153,47 @@ def cmd_bench(args) -> int:
 
 
 def cmd_gen(args) -> int:
-    from dsort_tpu.data.ingest import gen_uniform, gen_zipf, write_ints_file
+    from dsort_tpu.data.ingest import (
+        gen_terasort_file,
+        gen_uniform,
+        gen_zipf,
+        write_ints_file,
+    )
 
+    if args.dist == "terasort":
+        gen_terasort_file(args.output, args.n, seed=args.seed)
+        log.info("wrote %d terasort records to %s", args.n, args.output)
+        return 0
     if args.dist == "uniform":
         data = gen_uniform(args.n, dtype=np.dtype(args.dtype), seed=args.seed)
     else:
         data = gen_zipf(args.n, a=args.zipf_a, seed=args.seed)
     write_ints_file(args.output, data)
     log.info("wrote %d %s keys (%s) to %s", args.n, args.dtype, args.dist, args.output)
+    return 0
+
+
+def cmd_terasort(args) -> int:
+    """Sort a binary TeraSort record file (BASELINE config #4)."""
+    import jax
+
+    from dsort_tpu.data.ingest import read_terasort_file, write_terasort_file
+    from dsort_tpu.parallel.mesh import local_device_mesh
+    from dsort_tpu.parallel.sample_sort import SampleSort
+    from dsort_tpu.config import JobConfig
+
+    keys, payload = read_terasort_file(args.input)
+    mesh = local_device_mesh(args.workers)
+    job = JobConfig(key_dtype=np.uint64, payload_bytes=payload.shape[1])
+    metrics = Metrics()
+    t0 = time.perf_counter()
+    sk, sv = SampleSort(mesh, job).sort_kv(keys, payload, metrics=metrics)
+    dt = time.perf_counter() - t0
+    write_terasort_file(args.output or "terasort_out.bin", sk, sv)
+    log.info(
+        "terasort: %d records in %.1f ms (%.2f Mrec/s) | phases: %s",
+        len(keys), dt * 1e3, len(keys) / dt / 1e6, metrics.summary()["phases_ms"],
+    )
     return 0
 
 
@@ -233,11 +266,17 @@ def main(argv=None) -> int:
     p = sub.add_parser("gen", help="generate synthetic input files")
     p.add_argument("n", type=int)
     p.add_argument("-o", "--output", required=True)
-    p.add_argument("--dist", default="uniform", choices=["uniform", "zipf"])
+    p.add_argument("--dist", default="uniform", choices=["uniform", "zipf", "terasort"])
     p.add_argument("--dtype", default="int32")
     p.add_argument("--zipf-a", type=float, default=1.3)
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_gen)
+
+    p = sub.add_parser("terasort", help="sort a binary 100-byte-record file")
+    p.add_argument("input")
+    p.add_argument("-o", "--output")
+    p.add_argument("--workers", type=int, default=None)
+    p.set_defaults(fn=cmd_terasort)
 
     p = sub.add_parser("coordinator", help="native TCP coordinator + job REPL")
     common(p)  # provides --workers (cluster size; default 4 below)

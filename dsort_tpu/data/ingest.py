@@ -39,6 +39,46 @@ def gen_zipf(n: int, a: float = 1.3, dtype=np.int64, seed: int = 0) -> np.ndarra
     return rng.zipf(a, size=n).astype(dtype)
 
 
+RECORD_BYTES = 100  # TeraSort record: 10-byte key + 90-byte value
+
+
+def read_terasort_file(path: str | os.PathLike) -> tuple[np.ndarray, np.ndarray]:
+    """Read a binary TeraSort file into ``(packed_keys, payload)``.
+
+    Records are 100 bytes.  The first 8 key bytes pack big-endian into a
+    uint64 sort key; the remaining 92 bytes (2 key bytes + 90 value bytes)
+    ride as payload, so full records are preserved byte-exactly.
+    """
+    raw = np.fromfile(path, dtype=np.uint8)
+    if len(raw) % RECORD_BYTES:
+        raise ValueError(f"{path}: size {len(raw)} not a multiple of {RECORD_BYTES}")
+    raw = raw.reshape(-1, RECORD_BYTES)
+    keys = raw[:, :8].astype(np.uint64)
+    packed = np.zeros(len(raw), dtype=np.uint64)
+    for b in range(8):
+        packed = (packed << np.uint64(8)) | keys[:, b]
+    return packed, raw[:, 8:].copy()
+
+
+def write_terasort_file(
+    path: str | os.PathLike, keys: np.ndarray, payload: np.ndarray
+) -> None:
+    """Write ``(packed_keys, payload)`` back to 100-byte binary records."""
+    n = len(keys)
+    raw = np.empty((n, RECORD_BYTES), dtype=np.uint8)
+    k = keys.astype(np.uint64)
+    for b in range(8):
+        raw[:, b] = (k >> np.uint64(8 * (7 - b))).astype(np.uint8)
+    raw[:, 8:] = payload
+    raw.tofile(path)
+
+
+def gen_terasort_file(path: str | os.PathLike, n: int, seed: int = 0) -> None:
+    """Generate a binary TeraSort input file of ``n`` 100-byte records."""
+    keys, payload = gen_terasort(n, seed=seed)
+    write_terasort_file(path, keys, payload)
+
+
 def gen_terasort(
     n: int, key_bytes: int = 10, payload_bytes: int = 90, seed: int = 0
 ) -> tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,68 @@
+"""Multi-host runtime (SURVEY.md §7 step 6: the DCN-scale cluster path).
+
+The reference is single-master/4-workers over hand-rolled TCP.  At TPU-pod
+scale the cluster is formed by ``jax.distributed.initialize`` (one process
+per host, devices federated into one global mesh; XLA routes intra-slice
+collectives over ICI and cross-host legs over DCN) — the framework's
+`SampleSort` then runs unchanged over the global mesh, because shard_map
+programs are topology-agnostic.
+
+On a single host (or under the CPU simulation used in CI) everything here is
+a no-op passthrough, so the same code path serves laptop → pod.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from dsort_tpu.utils.logging import get_logger
+
+log = get_logger("distributed")
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host JAX cluster if one is configured.
+
+    Arguments default from the standard env vars (``JAX_COORDINATOR_ADDRESS``
+    / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``, as set by most TPU pod
+    launchers).  Returns True if distributed mode was initialized; False on a
+    single-process run (no-op — jax.distributed also auto-detects TPU pod
+    metadata when env vars are absent, which we deliberately do not force
+    here so CPU/simulated runs stay local).
+    """
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if addr is None:
+        return False
+    kwargs = {"coordinator_address": addr}
+    nproc = num_processes or os.environ.get("JAX_NUM_PROCESSES")
+    pid = process_id if process_id is not None else os.environ.get("JAX_PROCESS_ID")
+    if nproc is not None:
+        kwargs["num_processes"] = int(nproc)
+    if pid is not None:
+        kwargs["process_id"] = int(pid)
+    jax.distributed.initialize(**kwargs)
+    log.info(
+        "joined distributed cluster: process %d/%d, %d local + %d global devices",
+        jax.process_index(), jax.process_count(),
+        len(jax.local_devices()), len(jax.devices()),
+    )
+    return True
+
+
+def global_worker_mesh(axis_name: str = "w"):
+    """1-D mesh over ALL processes' devices (the pod-wide sort mesh).
+
+    With per-host data ingest, each host feeds its local shards and the
+    all_to_all shuffle crosses hosts over DCN exactly where the key ranges
+    demand — no master NIC bottleneck (contrast ``server.c:481-524``).
+    """
+    from jax.sharding import Mesh
+    import numpy as np
+
+    return Mesh(np.array(jax.devices()), (axis_name,))

@@ -99,3 +99,32 @@ def test_job_recovery_skips_completed_shards(tmp_path):
     # Without the checkpoint the same scheduler fails cleanly.
     with pytest.raises(JobFailedError):
         sched2.run_job(data, job_id="jobB")
+
+
+def test_cli_terasort_binary_roundtrip(tmp_path):
+    from dsort_tpu.data.ingest import read_terasort_file
+
+    inp, outp = tmp_path / "t.bin", tmp_path / "t_out.bin"
+    assert cli_main(["gen", "2000", "-o", str(inp), "--dist", "terasort"]) == 0
+    assert cli_main(["terasort", str(inp), "-o", str(outp), "--workers", "8"]) == 0
+    k_in, v_in = read_terasort_file(inp)
+    k_out, v_out = read_terasort_file(outp)
+    np.testing.assert_array_equal(k_out, np.sort(k_in))
+    # full records preserved as a multiset
+    assert sorted(zip(k_out.tolist(), map(bytes, v_out))) == sorted(
+        zip(k_in.tolist(), map(bytes, v_in))
+    )
+
+
+def test_multihost_initialize_noop_without_env(monkeypatch):
+    from dsort_tpu.parallel.distributed import initialize_multihost
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert initialize_multihost() is False
+
+
+def test_global_worker_mesh():
+    from dsort_tpu.parallel.distributed import global_worker_mesh
+
+    mesh = global_worker_mesh()
+    assert mesh.shape["w"] >= 8
