@@ -144,3 +144,18 @@ def test_coordinator_all_workers_dead_fails_cleanly(cluster):
         coord.run_job(data, num_shards=4)
     # Coordinator object survives for the next job/cluster (server.c:265-268).
     assert coord.num_live == 0
+
+
+def test_native_selftest_binary():
+    """Build + run the C++ in-process selftest (coordinator protocol,
+    reassignment, all-dead, merge, table) — no Python worker shims."""
+    native_dir = os.path.join(REPO, "dsort_tpu", "runtime", "native")
+    subprocess.run(
+        ["make", "-C", native_dir, "selftest"], check=True, capture_output=True
+    )
+    out = subprocess.run(
+        [os.path.join(native_dir, "selftest")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "SELFTEST PASS" in out.stdout
