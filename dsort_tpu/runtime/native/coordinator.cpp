@@ -34,46 +34,23 @@
 #include <thread>
 #include <vector>
 
+#include "protocol.h"
+
 namespace {
 
-constexpr uint32_t kTask = 1;
-constexpr uint32_t kResult = 2;
-constexpr uint32_t kHeartbeat = 3;
-constexpr uint32_t kShutdown = 4;
+using dsort::FrameHeader;
+using dsort::kHeartbeat;
+using dsort::kResult;
+using dsort::kShutdown;
+using dsort::kTask;
+using dsort::read_exact;
+using dsort::send_all;
 
 double now_s() {
   return std::chrono::duration<double>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
-
-bool read_exact(int fd, void* buf, size_t n) {
-  uint8_t* p = static_cast<uint8_t*>(buf);
-  while (n > 0) {
-    ssize_t r = ::recv(fd, p, n, 0);
-    if (r <= 0) return false;
-    p += r;
-    n -= r;
-  }
-  return true;
-}
-
-bool send_all(int fd, const void* buf, size_t n) {
-  const uint8_t* p = static_cast<const uint8_t*>(buf);
-  while (n > 0) {
-    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);  // no SIGPIPE (server.c:108-116)
-    if (r <= 0) return false;
-    p += r;
-    n -= r;
-  }
-  return true;
-}
-
-struct FrameHeader {
-  uint32_t type;
-  uint32_t task_id;
-  uint64_t len;
-} __attribute__((packed));
 
 struct Worker {
   int fd = -1;

@@ -1,0 +1,56 @@
+// Shared wire protocol for the dsort coordinator and its clients.
+//
+// Length-prefixed frames (little-endian): u32 type | u32 task_id | u64 len |
+// payload bytes.  Replaces the reference's raw int32 pages terminated by an
+// in-band -1 sentinel (server.c:405-406, client.c:113), which reserves a key
+// value; frames reserve nothing.  The Python worker shim
+// (dsort_tpu/runtime/worker.py) packs the same header with struct "<IIQ".
+
+#ifndef DSORT_PROTOCOL_H_
+#define DSORT_PROTOCOL_H_
+
+#include <sys/socket.h>
+
+#include <cstdint>
+
+namespace dsort {
+
+constexpr uint32_t kTask = 1;       // coord -> worker: sort this payload
+constexpr uint32_t kResult = 2;     // worker -> coord: sorted payload
+constexpr uint32_t kHeartbeat = 3;  // worker -> coord: liveness
+constexpr uint32_t kShutdown = 4;   // coord -> worker: exit cleanly
+
+struct FrameHeader {
+  uint32_t type;
+  uint32_t task_id;
+  uint64_t len;
+} __attribute__((packed));
+
+inline bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<unsigned char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// MSG_NOSIGNAL: a dead peer surfaces as an error return, never SIGPIPE —
+// the property the reference gets via signal(SIGPIPE, SIG_IGN)
+// (server.c:108-116).
+inline bool send_all(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const unsigned char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace dsort
+
+#endif  // DSORT_PROTOCOL_H_

@@ -14,6 +14,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include "protocol.h"
+
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
@@ -56,36 +58,14 @@ namespace {
     }                                                                   \
   } while (0)
 
-struct Hdr {
-  uint32_t type;
-  uint32_t task_id;
-  uint64_t len;
-} __attribute__((packed));
+using Hdr = dsort::FrameHeader;
+using dsort::read_exact;
+using dsort::send_all;
 
-bool readx(int fd, void* p, size_t n) {
-  auto* b = static_cast<uint8_t*>(p);
-  while (n) {
-    ssize_t r = ::recv(fd, b, n, 0);
-    if (r <= 0) return false;
-    b += r;
-    n -= r;
-  }
-  return true;
-}
-
-bool sendx(int fd, const void* p, size_t n) {
-  auto* b = static_cast<const uint8_t*>(p);
-  while (n) {
-    ssize_t r = ::send(fd, b, n, MSG_NOSIGNAL);
-    if (r <= 0) return false;
-    b += r;
-    n -= r;
-  }
-  return true;
-}
-
-// A fake worker: connects, sorts int32 task payloads, replies.
-void fake_worker(uint16_t port, std::atomic<bool>* stop) {
+// A fake worker: connects, sorts int32 task payloads, replies after
+// delay_ms (a nonzero delay keeps tasks in flight long enough for kill
+// tests to exercise the reassignment path deterministically).
+void fake_worker(uint16_t port, std::atomic<bool>* stop, int delay_ms) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   sockaddr_in a{};
   a.sin_family = AF_INET;
@@ -97,15 +77,17 @@ void fake_worker(uint16_t port, std::atomic<bool>* stop) {
   }
   while (!stop->load()) {
     Hdr h;
-    if (!readx(fd, &h, sizeof(h))) break;
-    if (h.type == 4) break;  // shutdown
-    if (h.type != 1) continue;
+    if (!read_exact(fd, &h, sizeof(h))) break;
+    if (h.type == dsort::kShutdown) break;
+    if (h.type != dsort::kTask) continue;
     std::vector<uint8_t> buf(h.len);
-    if (h.len && !readx(fd, buf.data(), h.len)) break;
+    if (h.len && !read_exact(fd, buf.data(), h.len)) break;
     auto* ints = reinterpret_cast<int32_t*>(buf.data());
     std::sort(ints, ints + h.len / 4);
-    Hdr r{2, h.task_id, h.len};
-    if (!sendx(fd, &r, sizeof(r)) || !sendx(fd, buf.data(), h.len)) break;
+    if (delay_ms > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+    Hdr r{dsort::kResult, h.task_id, h.len};
+    if (!send_all(fd, &r, sizeof(r)) || !send_all(fd, buf.data(), h.len)) break;
   }
   ::close(fd);
 }
@@ -142,15 +124,19 @@ void test_merge_and_table() {
 }
 
 void test_coordinator() {
-  void* c = dsort_coord_create(0, 5.0);
+  // hb_timeout=0 disables the heartbeat monitor: fake workers send no
+  // heartbeats, and this test covers the exchange paths, not liveness
+  // timing (the Python cluster tests cover heartbeats with real shims).
+  void* c = dsort_coord_create(0, 0.0);
   CHECK(c != nullptr);
   uint16_t port = static_cast<uint16_t>(dsort_coord_port(c));
   std::atomic<bool> stop{false};
   std::vector<std::thread> workers;
-  for (int i = 0; i < 4; ++i) workers.emplace_back(fake_worker, port, &stop);
+  for (int i = 0; i < 4; ++i)
+    workers.emplace_back(fake_worker, port, &stop, /*delay_ms=*/150);
   CHECK(dsort_coord_wait_workers(c, 4, 10.0) >= 4);
 
-  // Healthy jobs, concurrent submit/collect from multiple threads.
+  // Concurrent submit/collect from multiple threads.
   std::mt19937 rng(7);
   std::vector<std::vector<int32_t>> shards(8);
   for (uint32_t i = 0; i < 8; ++i) {
@@ -160,7 +146,8 @@ void test_coordinator() {
               c, i, reinterpret_cast<const uint8_t*>(shards[i].data()),
               shards[i].size() * 4) == 0);
   }
-  // Kill one worker while results stream back (reassignment path).
+  // Kill worker 2 while its affine tasks (ids 2 and 6) are still in flight
+  // (workers reply after 150 ms) — forces the reassignment path.
   dsort_coord_kill_worker(c, 2);
   std::vector<std::thread> collectors;
   std::atomic<int> ok{0};
@@ -178,18 +165,22 @@ void test_coordinator() {
   for (auto& t : collectors) t.join();
   CHECK(ok.load() == 8);
   CHECK(dsort_coord_num_live(c) == 3);
+  // The dead worker's affine tasks were re-dispatched: either the send into
+  // its closed socket failed (send-path detection -> reassignments_++) or
+  // its reader died with tasks in flight (recv-path detection).
+  CHECK(dsort_coord_reassignments(c) >= 1);
 
   stop.store(true);
   dsort_coord_destroy(c);  // sends shutdown; workers unblock and exit
   for (auto& t : workers) t.join();
-  std::printf("coordinator ok (reassignments=%s)\n", "n/a post-destroy");
+  std::printf("coordinator ok\n");
 }
 
 void test_all_dead() {
   void* c = dsort_coord_create(0, 2.0);
   uint16_t port = static_cast<uint16_t>(dsort_coord_port(c));
   std::atomic<bool> stop{false};
-  std::thread w(fake_worker, port, &stop);
+  std::thread w(fake_worker, port, &stop, /*delay_ms=*/0);
   CHECK(dsort_coord_wait_workers(c, 1, 10.0) >= 1);
   dsort_coord_kill_worker(c, 0);
   w.join();
@@ -198,9 +189,10 @@ void test_all_dead() {
   int32_t v = 42;
   int rc = dsort_coord_submit(c, 0, reinterpret_cast<uint8_t*>(&v), 4);
   if (rc == 0) {
-    // Submit raced the death detection; collect must fail cleanly.
+    // Submit raced the death detection; the task must FAIL cleanly (-1),
+    // not time out (-2) — a hang here would be a regression.
     uint8_t out[4];
-    CHECK(dsort_coord_collect(c, 0, out, 4, 10.0) < 0);
+    CHECK(dsort_coord_collect(c, 0, out, 4, 20.0) == -1);
   }
   dsort_coord_destroy(c);
   std::printf("all-dead ok\n");
