@@ -76,3 +76,31 @@ def test_sample_sort_kv_duplicate_keys_keep_payloads(mesh8):
     assert sorted(zip(sk.tolist(), map(bytes, sv))) == sorted(
         zip(keys.tolist(), map(bytes, payload))
     )
+
+
+@pytest.mark.parametrize("dtype", [np.uint32, np.float32, np.float64])
+def test_sample_sort_more_dtypes(mesh8, dtype):
+    rng = np.random.default_rng(41)
+    if np.issubdtype(dtype, np.floating):
+        data = (rng.standard_normal(10_000) * 1e6).astype(dtype)
+    else:
+        data = rng.integers(0, np.iinfo(dtype).max, 10_000, dtype=dtype)
+    out = SampleSort(mesh8, JobConfig(key_dtype=dtype)).sort(data)
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_sample_sort_fuzz_distributions(mesh8):
+    # Property sweep: one padded shape (shared compile), many distributions.
+    rng = np.random.default_rng(43)
+    n = 9_999
+    cases = [
+        rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32),   # full range
+        rng.integers(0, 10, n).astype(np.int32),                 # tiny alphabet
+        np.sort(rng.integers(0, 10**6, n)).astype(np.int32),     # presorted
+        np.sort(rng.integers(0, 10**6, n))[::-1].astype(np.int32),  # reversed
+        np.concatenate([np.zeros(n // 2), rng.integers(0, 100, n - n // 2)]).astype(np.int32),  # half zeros
+    ]
+    sorter = SampleSort(mesh8)
+    for i, data in enumerate(cases):
+        out = sorter.sort(data)
+        np.testing.assert_array_equal(out, np.sort(data), err_msg=f"case {i}")
