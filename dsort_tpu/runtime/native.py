@@ -42,7 +42,7 @@ def _load():
         except OSError:
             return None
         # K-way merge signatures.
-        for name in ("i32", "i64", "u64"):
+        for name in ("i32", "i64", "u64", "u32"):
             fn = getattr(lib, f"dsort_kway_merge_{name}")
             fn.restype = None
             fn.argtypes = [
@@ -115,6 +115,7 @@ _MERGE_FNS = {
     np.dtype(np.int32): "dsort_kway_merge_i32",
     np.dtype(np.int64): "dsort_kway_merge_i64",
     np.dtype(np.uint64): "dsort_kway_merge_u64",
+    np.dtype(np.uint32): "dsort_kway_merge_u32",
 }
 _MERGE_KV_FNS = {
     np.dtype(np.uint64): "dsort_kway_merge_kv_u64",

@@ -207,6 +207,11 @@ void dsort_kway_merge_u64(const uint64_t** runs, const int64_t* lens,
   kway_merge<uint64_t>(runs, lens, nruns, out);
 }
 
+void dsort_kway_merge_u32(const uint32_t** runs, const int64_t* lens,
+                          int32_t nruns, uint32_t* out) {
+  kway_merge<uint32_t>(runs, lens, nruns, out);
+}
+
 void dsort_kway_merge_kv_u64(const uint64_t** kruns, const uint8_t** vruns,
                              const int64_t* lens, int32_t nruns, int32_t pbytes,
                              uint64_t* out_k, uint8_t* out_v) {

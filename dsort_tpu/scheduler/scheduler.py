@@ -235,13 +235,58 @@ class SpmdScheduler:
     def _live_devices(self) -> list[jax.Device]:
         return [self.devices[i] for i in self.table.live_workers()]
 
-    def sort(self, data: np.ndarray, metrics: Metrics | None = None) -> np.ndarray:
+    def _local_sort_phase(
+        self, data: np.ndarray, ckpt, metrics: Metrics
+    ) -> np.ndarray:
+        """Phase A: per-shard local sort, persisted at the phase boundary.
+
+        A compiled collective can't lose a participant mid-flight, so
+        recovery is phrased as re-running a *phase* (SURVEY.md §7).  The
+        local-sort phase's outputs (sorted runs) are the checkpointed
+        boundary: a re-run of the same job (or a re-formed mesh after a
+        failure in the shuffle phase) restores them instead of re-sorting.
+        Returns the concatenated sorted runs — already-sorted input for the
+        shuffle phase; the shuffle itself is input-order agnostic.
+        """
+        import jax.numpy as jnp
+
+        from dsort_tpu.data.partition import pad_to_shards
+        from dsort_tpu.ops.local_sort import sort_padded
+
+        done = set(ckpt.completed_shards())
+        w = max(len(self.devices), 1)
+        shards, counts = pad_to_shards(data, w)
+        if done != set(range(w)):
+            sorted_shards, _ = jax.jit(jax.vmap(sort_padded))(
+                jnp.asarray(shards), jnp.asarray(counts)
+            )
+            host = np.asarray(sorted_shards)
+            for i in range(w):
+                if i not in done:
+                    ckpt.save(i, host[i, : counts[i]])
+        else:
+            metrics.bump("spmd_phase_restores")
+        return np.concatenate([ckpt.load(i) for i in range(w)])
+
+    def sort(
+        self,
+        data: np.ndarray,
+        metrics: Metrics | None = None,
+        job_id: str | None = None,
+    ) -> np.ndarray:
         from jax.sharding import Mesh
 
         from dsort_tpu.parallel.sample_sort import SampleSort
 
         metrics = metrics if metrics is not None else Metrics()
         self.table.revive_all()
+        ckpt = None
+        work = data
+        if self.job.checkpoint_dir and job_id and len(data):
+            from dsort_tpu.checkpoint import ShardCheckpoint
+
+            ckpt = ShardCheckpoint(self.job.checkpoint_dir, job_id)
+            ckpt.write_manifest(len(self.devices), np.asarray(data).dtype, len(data))
         while True:
             live = self.table.live_workers()
             if not live:
@@ -249,10 +294,14 @@ class SpmdScheduler:
             devs = [self.devices[i] for i in live]
             mesh = Mesh(np.array(devs), (self.axis,))
             try:
+                if ckpt is not None:
+                    work = self._local_sort_phase(data, ckpt, metrics)
+                # Injection point models a device lost in the shuffle phase —
+                # i.e. after the checkpointed local-sort phase boundary.
                 if self.injector is not None:
                     for i in live:
                         self.injector.check(i, "spmd")
-                out = SampleSort(mesh, self.job, self.axis).sort(data, metrics)
+                out = SampleSort(mesh, self.job, self.axis).sort(work, metrics)
                 return out
             except WorkerFailure as e:
                 log.warning(

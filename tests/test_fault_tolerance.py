@@ -134,3 +134,22 @@ def test_spmd_scheduler_all_dead(mesh8):
     sched = SpmdScheduler(job=FAST, injector=inj)
     with pytest.raises(JobFailedError):
         sched.sort(gen_uniform(100, seed=8))
+
+
+def test_spmd_checkpointed_phase_recovery(mesh8, tmp_path):
+    # Failure during the shuffle phase -> mesh re-forms; the local-sort
+    # phase's checkpointed runs are restored instead of re-sorted
+    # (SURVEY.md §7: re-run the phase from the last shard boundary).
+    inj = FaultInjector()
+    inj.fail_once(1, "spmd")
+    job = JobConfig(
+        settle_delay_s=0.01, checkpoint_dir=str(tmp_path), heartbeat_timeout_s=5.0
+    )
+    sched = SpmdScheduler(job=job, injector=inj)
+    data = gen_uniform(30_000, seed=51)
+    m = Metrics()
+    out = sched.sort(data, metrics=m, job_id="spmdjob")
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters["mesh_reforms"] == 1
+    # The retry found all runs checkpointed and restored them.
+    assert m.counters["spmd_phase_restores"] >= 1

@@ -19,7 +19,7 @@ pytestmark = pytest.mark.skipif(
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint64])
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint64, np.uint32])
 def test_native_kway_merge_parity(dtype):
     rng = np.random.default_rng(1)
     info = np.iinfo(dtype)
@@ -27,6 +27,7 @@ def test_native_kway_merge_parity(dtype):
         np.sort(rng.integers(info.min, info.max, n, dtype=dtype))
         for n in (0, 17, 1000, 3, 4096)
     ]
+    runs = [r.astype(dtype) for r in runs]
     out = native.kway_merge(runs)
     np.testing.assert_array_equal(out, np.sort(np.concatenate(runs)))
 
