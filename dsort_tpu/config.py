@@ -93,6 +93,7 @@ class JobConfig:
     key_dtype: Any = jnp.int32
     payload_bytes: int = 0          # 0 → key-only sort; >0 → TeraSort-style records
     local_kernel: str = "lax"       # per-chip sort: "lax" | "bitonic" | "pallas"
+    merge_kernel: str = "sort"      # post-shuffle combine: "sort" | "bitonic"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
     capacity_factor: float = 2.0    # per-(src,dst) all_to_all bucket headroom
@@ -118,6 +119,10 @@ class JobConfig:
         if self.local_kernel not in LOCAL_KERNELS:
             raise ConfigError(
                 f"local_kernel must be one of {LOCAL_KERNELS}, got {self.local_kernel!r}"
+            )
+        if self.merge_kernel not in ("sort", "bitonic"):
+            raise ConfigError(
+                f"merge_kernel must be 'sort' or 'bitonic', got {self.merge_kernel!r}"
             )
         if self.oversample < 1:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")
@@ -156,6 +161,7 @@ class SortConfig:
             key_dtype=jnp.dtype(m.get("KEY_DTYPE", "int32")),
             payload_bytes=geti("PAYLOAD_BYTES", 0),
             local_kernel=m.get("LOCAL_KERNEL", "lax"),
+            merge_kernel=m.get("MERGE_KERNEL", "sort"),
             oversample=geti("OVERSAMPLE", 32),
             capacity_factor=float(m.get("CAPACITY_FACTOR", 2.0)),
             heartbeat_timeout_s=float(m.get("HEARTBEAT_TIMEOUT_S", 10.0)),

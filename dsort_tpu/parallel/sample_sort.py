@@ -79,8 +79,42 @@ def _bucket_slices(xs_sorted, count, splitters, cap_pair: int):
     return jnp.clip(gidx, 0, max(n_local - 1, 0)), valid, lens, overflow
 
 
+def _merge_received(recv: jax.Array, merge_kernel: str) -> jax.Array:
+    """Combine the received (P, cap) buffer into one sorted (P*cap,) run.
+
+    Each row arrives sorted with sentinel pads at its tail, so rows ARE
+    sorted runs: "bitonic" merges them with an O(n log P) bitonic merge tree
+    (pure VPU work on TPU); "sort" re-sorts flat (O(n log n), but XLA's sort
+    is heavily tuned).  Both yield identical output.
+    """
+    if merge_kernel == "bitonic":
+        from dsort_tpu.ops.bitonic import _ceil_pow2, merge_sorted_runs
+
+        sent = sentinel_for(recv.dtype)
+        p, cap = recv.shape
+        out_len = p * cap
+        # The bitonic network needs power-of-two lengths on both axes; pad
+        # rows (non-pow2 mesh after a failure) and row length (cap is only
+        # 8-aligned) with sentinels — padded rows/tails stay sorted.
+        cap2 = _ceil_pow2(cap)
+        if cap2 != cap:
+            recv = jnp.concatenate(
+                [recv, jnp.full((p, cap2 - cap), sent, recv.dtype)], axis=1
+            )
+        r = _ceil_pow2(p)
+        if r != p:
+            recv = jnp.concatenate(
+                [recv, jnp.full((r - p, cap2), sent, recv.dtype)]
+            )
+        # All valid keys sort ahead of the pads, so trimming to the original
+        # total keeps every valid element and matches the "sort" path shape.
+        return merge_sorted_runs(recv)[:out_len]
+    return jnp.sort(recv.reshape(-1))
+
+
 def _sample_sort_shard(
-    xs, count, *, num_workers, oversample, cap_pair, axis, kernel="lax"
+    xs, count, *, num_workers, oversample, cap_pair, axis,
+    kernel="lax", merge_kernel="sort",
 ):
     """One device's view of the whole distributed sort (runs under shard_map).
 
@@ -95,7 +129,7 @@ def _sample_sort_shard(
     send = jnp.where(valid, xs[gidx], sent)
     recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)       # 4
     lens_recv = jax.lax.all_to_all(lens[:, None], axis, split_axis=0, concat_axis=0)[:, 0]
-    merged = jnp.sort(recv.reshape(-1))                                      # 5
+    merged = _merge_received(recv, merge_kernel)                             # 5
     out_count = jnp.sum(lens_recv).astype(jnp.int32)
     return merged, out_count[None], overflow[None]
 
@@ -154,7 +188,10 @@ class SampleSort:
         )
         if kv_trailing is None:
             fn = functools.partial(
-                _sample_sort_shard, kernel=self.job.local_kernel, **kwargs
+                _sample_sort_shard,
+                kernel=self.job.local_kernel,
+                merge_kernel=self.job.merge_kernel,
+                **kwargs,
             )
             in_specs = (P(self.axis), P(self.axis))
             out_specs = (P(self.axis), P(self.axis), P(self.axis))

@@ -104,3 +104,21 @@ def test_sample_sort_fuzz_distributions(mesh8):
     for i, data in enumerate(cases):
         out = sorter.sort(data)
         np.testing.assert_array_equal(out, np.sort(data), err_msg=f"case {i}")
+
+
+def test_sample_sort_bitonic_merge_kernel(mesh8):
+    data = gen_uniform(30_000, seed=61)
+    out = SampleSort(mesh8, JobConfig(merge_kernel="bitonic")).sort(data)
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_sample_sort_bitonic_merge_on_7_device_mesh():
+    # Non-power-of-two mesh (post-failure shape): merge tree pads rows.
+    import jax
+
+    from dsort_tpu.parallel.mesh import local_device_mesh
+
+    mesh7 = local_device_mesh(7)
+    data = gen_uniform(10_000, seed=62)
+    out = SampleSort(mesh7, JobConfig(merge_kernel="bitonic")).sort(data)
+    np.testing.assert_array_equal(out, np.sort(data))
