@@ -34,11 +34,12 @@ class ShardCheckpoint:
     def _shard_path(self, shard_id: int) -> str:
         return os.path.join(self.dir, f"shard_{shard_id:05d}.npy")
 
-    def write_manifest(self, num_shards: int, dtype, total: int) -> None:
+    def write_manifest(self, num_shards: int, dtype, total: int, **extra) -> None:
         tmp = self._manifest_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(
-                {"num_shards": num_shards, "dtype": str(np.dtype(dtype)), "total": total},
+                {"num_shards": num_shards, "dtype": str(np.dtype(dtype)),
+                 "total": total, **extra},
                 f,
             )
         os.replace(tmp, self._manifest_path)
@@ -62,6 +63,10 @@ class ShardCheckpoint:
 
     def load(self, shard_id: int) -> np.ndarray:
         return np.load(self._shard_path(shard_id))
+
+    def load_mmap(self, shard_id: int) -> np.ndarray:
+        """Memory-mapped read — out-of-core merge inputs never load fully."""
+        return np.load(self._shard_path(shard_id), mmap_mode="r")
 
     def completed_shards(self) -> list[int]:
         out = []

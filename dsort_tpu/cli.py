@@ -46,6 +46,7 @@ def _load_config(args) -> SortConfig:
             "SERVER_PORT": str(cfg.server_port),
             "KEY_DTYPE": str(np.dtype(cfg.job.key_dtype)),
             "LOCAL_KERNEL": cfg.job.local_kernel,
+            "MERGE_KERNEL": cfg.job.merge_kernel,
         }
         if cfg.mesh.num_workers is not None:
             base["NUM_WORKERS"] = str(cfg.mesh.num_workers)
@@ -197,6 +198,30 @@ def cmd_terasort(args) -> int:
     return 0
 
 
+def cmd_external(args) -> int:
+    """Out-of-core sort of a raw binary key file (runs + native merge)."""
+    from dsort_tpu.models.external_sort import ExternalSort
+
+    s = ExternalSort(
+        run_elems=args.run_elems,
+        spill_dir=args.spill_dir,
+        job_id=args.job_id,
+        local_kernel=args.kernel or "lax",
+        resume=not args.no_resume,
+    )
+    metrics = Metrics()
+    t0 = time.perf_counter()
+    s.sort_binary_file(args.input, args.output, dtype=np.dtype(args.dtype or "int32"),
+                       metrics=metrics)
+    dt = time.perf_counter() - t0
+    log.info(
+        "external-sorted %s -> %s in %.1f ms | %s | phases: %s",
+        args.input, args.output, dt * 1e3, dict(metrics.counters),
+        metrics.summary()["phases_ms"],
+    )
+    return 0
+
+
 def cmd_coordinator(args) -> int:
     """Run the native coordinator and serve REPL jobs over the cluster."""
     from dsort_tpu.runtime import NativeCoordinator
@@ -245,7 +270,7 @@ def main(argv=None) -> int:
                        choices=["spmd", "taskpool", "local"])
         p.add_argument("--workers", type=int)
         p.add_argument("--dtype")
-        p.add_argument("--kernel", choices=["lax", "bitonic", "pallas"])
+        p.add_argument("--kernel", choices=["lax", "bitonic", "pallas", "radix"])
         p.add_argument("-o", "--output")
 
     p = sub.add_parser("run", help="sort one file")
@@ -277,6 +302,18 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--output")
     p.add_argument("--workers", type=int, default=None)
     p.set_defaults(fn=cmd_terasort)
+
+    p = sub.add_parser("external", help="out-of-core sort of a raw binary key file")
+    p.add_argument("input")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--dtype", default="int32")
+    p.add_argument("--kernel", choices=["lax", "bitonic", "pallas", "radix"])
+    p.add_argument("--run-elems", type=int, default=1 << 22)
+    p.add_argument("--spill-dir")
+    p.add_argument("--job-id", default="external")
+    p.add_argument("--no-resume", action="store_true",
+                   help="discard checkpointed runs and start fresh")
+    p.set_defaults(fn=cmd_external)
 
     p = sub.add_parser("coordinator", help="native TCP coordinator + job REPL")
     common(p)  # provides --workers (cluster size; default 4 below)

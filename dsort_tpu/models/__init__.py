@@ -10,8 +10,11 @@ output is a total ascending order of the input.
 - ``sample_sort`` (in ``parallel.sample_sort``): splitter-based all_to_all
   shuffle + per-chip merge — the scalable path that removes the central merge
   (SURVEY.md §5.7).
+- ``external_sort``: out-of-core runs-on-disk + native streaming merge for
+  datasets larger than device/host memory.
 """
 
+from dsort_tpu.models.external_sort import ExternalSort  # noqa: F401
 from dsort_tpu.models.pipelines import (  # noqa: F401
     GatherMergeSort,
     local_pipeline,

@@ -1,0 +1,215 @@
+"""Out-of-core external sort: device-sized runs + native k-way merge.
+
+The reference caps a whole job at 16,384 ints because every chunk must fit a
+worker's fixed stack buffer (``server.c:13,193-196``, ``client.c:10,91``).
+This pipeline removes the cap in the other direction too — datasets larger
+than device memory (or host RAM):
+
+1. **run generation** — the input is consumed in fixed-size slices; each
+   slice is sorted on-chip (one compiled program reused for every run via
+   sentinel padding) and spilled to disk as a checkpointed sorted run;
+2. **merge** — the native C++ heap merge (O(N log k),
+   ``runtime/native/dsort_native.cpp``) streams the runs into the output
+   buffer, which may be a disk-backed memmap, so peak resident memory is
+   O(run_elems), independent of N.
+
+Runs are stored through `checkpoint.ShardCheckpoint` (atomic rename writes),
+so a killed job resumes by re-sorting only the missing runs — the SURVEY.md
+§5.4 upgrade over the reference's restart-the-chunk recovery, applied at
+out-of-core scale.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsort_tpu.checkpoint import ShardCheckpoint
+from dsort_tpu.ops.local_sort import sentinel_for, sort_with_kernel
+from dsort_tpu.utils.logging import get_logger
+from dsort_tpu.utils.metrics import Metrics, PhaseTimer
+
+log = get_logger("external_sort")
+
+
+def _fingerprint(data: np.ndarray, samples: int = 16) -> str:
+    """Cheap identity check for resume: length, dtype, and sampled bytes.
+
+    Reads at most ``samples`` single elements, so it is O(1) even on a
+    memmap of a huge file.
+    """
+    n = len(data)
+    idx = np.unique(np.linspace(0, n - 1, num=min(samples, n), dtype=np.int64))
+    picks = np.asarray([data[int(i)] for i in idx])
+    return f"{n}:{data.dtype}:{picks.tobytes().hex()}"
+
+
+class ExternalSort:
+    """Sort arrays/files of any size with bounded resident memory.
+
+    ``run_elems``: keys per sorted run (the device working-set size).
+    ``spill_dir``: where checkpointed runs live (default: a temp dir).
+    ``job_id``: resume key — a re-run with the same id skips finished runs.
+    """
+
+    def __init__(
+        self,
+        run_elems: int = 1 << 22,
+        spill_dir: str | None = None,
+        job_id: str = "external",
+        local_kernel: str = "lax",
+        resume: bool = True,
+    ):
+        if run_elems < 2:
+            raise ValueError("run_elems must be >= 2")
+        self.run_elems = int(run_elems)
+        self.spill_dir = spill_dir or os.path.join(
+            tempfile.gettempdir(), "dsort_external"
+        )
+        self.job_id = job_id
+        self.local_kernel = local_kernel
+        self.resume = resume
+        self._sort_fn = jax.jit(
+            lambda x: sort_with_kernel(x, local_kernel)
+        )
+
+    def _sort_run(self, chunk: np.ndarray) -> np.ndarray:
+        """Sort one slice on device behind a fixed padded shape (one compile)."""
+        n = len(chunk)
+        if n == self.run_elems:
+            buf = jnp.asarray(chunk)
+        else:  # final partial run: sentinel-pad so the jitted shape is reused
+            sent = np.asarray(sentinel_for(chunk.dtype))
+            padded = np.full(self.run_elems, sent, dtype=chunk.dtype)
+            padded[:n] = chunk
+            buf = jnp.asarray(padded)
+        out = np.asarray(self._sort_fn(buf))
+        if n != self.run_elems:
+            # Trim is exact even when real keys equal the sentinel: the sort
+            # moved exactly (run_elems - n) pads to the tail.
+            out = out[:n]
+        return out
+
+    def sort(
+        self,
+        data: np.ndarray,
+        out: np.ndarray | None = None,
+        metrics: Metrics | None = None,
+    ) -> np.ndarray:
+        """Sort ``data`` (ndarray or memmap); result lands in ``out`` if given.
+
+        ``data`` is only read in ``run_elems`` slices and ``out`` may be a
+        memmap, so neither end needs to fit in RAM.
+        """
+        metrics = metrics if metrics is not None else Metrics()
+        timer = PhaseTimer(metrics)
+        n = len(data)
+        if n == 0:
+            return np.asarray(data).copy() if out is None else out
+        ckpt = ShardCheckpoint(self.spill_dir, self.job_id)
+        num_runs = -(-n // self.run_elems)
+        fp = _fingerprint(data)
+        if not self.resume:
+            ckpt.clear()
+        else:
+            # Trust checkpointed runs only if they came from THIS job: same
+            # shape, dtype, run size, and data fingerprint.  Otherwise a
+            # reused job_id would silently return the previous job's output.
+            # No/unreadable manifest with shards present is equally untrusted
+            # (e.g. a crash mid-clear() deleted the manifest first).
+            m = ckpt.manifest()
+            stale = (
+                m is None
+                and bool(ckpt.completed_shards())
+            ) or (
+                m is not None
+                and (
+                    m.get("num_shards") != num_runs
+                    or m.get("dtype") != str(data.dtype)
+                    or m.get("total") != n
+                    or m.get("run_elems") != self.run_elems
+                    or m.get("fingerprint") != fp
+                )
+            )
+            if stale:
+                log.warning(
+                    "job %r: checkpointed runs belong to different data; clearing",
+                    self.job_id,
+                )
+                ckpt.clear()
+        ckpt.write_manifest(
+            num_runs, data.dtype, n, run_elems=self.run_elems, fingerprint=fp
+        )
+        with timer.phase("run_generation"):
+            for i in range(num_runs):
+                if self.resume and ckpt.has(i):
+                    metrics.bump("runs_resumed")
+                    continue
+                lo = i * self.run_elems
+                chunk = np.asarray(data[lo : min(lo + self.run_elems, n)])
+                ckpt.save(i, self._sort_run(chunk))
+                metrics.bump("runs_sorted")
+        with timer.phase("merge"):
+            runs = [ckpt.load_mmap(i) for i in range(num_runs)]
+            if num_runs == 1:
+                # np.array copies: the result must not alias (read-only)
+                # checkpoint files that a later clear() would invalidate.
+                if out is None:
+                    out = np.array(runs[0])
+                else:
+                    out[:] = runs[0]
+            else:
+                out = self._merge(runs, out, metrics)
+        return out
+
+    def _merge(self, runs, out, metrics: Metrics):
+        from dsort_tpu.runtime import native
+
+        total = sum(len(r) for r in runs)
+        if native.available() and native.supports_dtype(runs[0].dtype):
+            if out is None:
+                out = np.empty(total, dtype=runs[0].dtype)
+            metrics.bump("native_merges")
+            return native.kway_merge(runs, out=out)
+        from dsort_tpu.ops.merge import merge_sorted_host
+
+        merged = merge_sorted_host([np.asarray(r) for r in runs])
+        if out is None:
+            return merged
+        out[:] = merged
+        return out
+
+    def sort_binary_file(
+        self,
+        in_path: str,
+        out_path: str,
+        dtype=np.int32,
+        metrics: Metrics | None = None,
+    ) -> None:
+        """Sort a raw binary key file into ``out_path``, out-of-core end to end.
+
+        Input is memmapped (read in run-sized slices); output is written
+        through a memmap the native merge streams into.
+        """
+        dtype = np.dtype(dtype)
+        size = os.path.getsize(in_path)
+        if size % dtype.itemsize:
+            raise ValueError(
+                f"{in_path}: size {size} not a multiple of itemsize {dtype.itemsize}"
+            )
+        n = size // dtype.itemsize
+        if n == 0:  # numpy cannot mmap an empty file; emit an empty output
+            open(out_path, "wb").close()
+            return
+        data = np.memmap(in_path, dtype=dtype, mode="r")
+        out = np.lib.format.open_memmap(  # .npy so dtype/shape are recorded
+            out_path, mode="w+", dtype=dtype, shape=(n,)
+        ) if out_path.endswith(".npy") else np.memmap(
+            out_path, dtype=dtype, mode="w+", shape=(n,)
+        )
+        self.sort(data, out=out, metrics=metrics)
+        out.flush()

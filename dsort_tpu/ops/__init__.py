@@ -1,4 +1,4 @@
-"""Per-chip compute kernels: lax.sort wrappers, merges, bitonic/Pallas sorts."""
+"""Per-chip compute kernels: lax.sort wrappers, merges, bitonic/Pallas/radix sorts."""
 
 from dsort_tpu.ops.local_sort import (  # noqa: F401
     sentinel_for,
@@ -6,3 +6,4 @@ from dsort_tpu.ops.local_sort import (  # noqa: F401
     sort_kv,
     sort_padded,
 )
+from dsort_tpu.ops.radix import radix_sort, radix_sort_kv  # noqa: F401

@@ -103,3 +103,48 @@ def merge_sorted_runs(runs: jax.Array) -> jax.Array:
         runs = jax.vmap(bitonic_merge_pair)(runs[0::2], runs[1::2])
         r //= 2
     return runs[0]
+
+
+def bitonic_merge_pair_kv(
+    ak: jax.Array, av: jax.Array, bk: jax.Array, bv: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Key+value merge of two sorted equal-length runs.
+
+    Exchanges are decided lexicographically on ``(key, value)`` — with the
+    value a global index this makes the whole pipeline a *stable* sort and
+    lets sentinel-padded buffers trim exactly (pads carry indices above every
+    real entry, so a real key equal to the sentinel still sorts first).
+    """
+    n = ak.shape[0]
+    assert bk.shape[0] == n, "bitonic_merge_pair_kv needs equal-length runs"
+    k = jnp.concatenate([ak, bk[::-1]])
+    v = jnp.concatenate([av, bv[::-1]])
+    total = 2 * n
+    j = total // 2
+    while j >= 1:
+        kk = k.reshape(total // (2 * j), 2, j)
+        vv = v.reshape(total // (2 * j), 2, j)
+        k1, k2 = kk[:, 0, :], kk[:, 1, :]
+        v1, v2 = vv[:, 0, :], vv[:, 1, :]
+        swap = (k1 > k2) | ((k1 == k2) & (v1 > v2))
+        k = jnp.stack(
+            [jnp.where(swap, k2, k1), jnp.where(swap, k1, k2)], axis=1
+        ).reshape(total)
+        v = jnp.stack(
+            [jnp.where(swap, v2, v1), jnp.where(swap, v1, v2)], axis=1
+        ).reshape(total)
+        j //= 2
+    return k, v
+
+
+def merge_sorted_runs_kv(
+    keys: jax.Array, vals: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Key+value tree merge of ``(R, n)`` sorted rows (R a power of two)."""
+    r = keys.shape[0]
+    while r > 1:
+        keys, vals = jax.vmap(bitonic_merge_pair_kv)(
+            keys[0::2], vals[0::2], keys[1::2], vals[1::2]
+        )
+        r //= 2
+    return keys[0], vals[0]

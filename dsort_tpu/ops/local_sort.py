@@ -61,7 +61,7 @@ def sort_kv(keys: jax.Array, payload: jax.Array) -> tuple[jax.Array, jax.Array]:
     return out_k, _apply_perm(payload, perm, keys.ndim - 1)
 
 
-LOCAL_KERNELS = ("lax", "bitonic", "pallas")
+LOCAL_KERNELS = ("lax", "bitonic", "pallas", "radix")
 
 
 def sort_with_kernel(keys: jax.Array, kernel: str = "lax") -> jax.Array:
@@ -69,7 +69,8 @@ def sort_with_kernel(keys: jax.Array, kernel: str = "lax") -> jax.Array:
 
     - ``lax``: XLA's built-in sort (the default; best all-round on TPU);
     - ``bitonic``: the pure-jnp vectorized bitonic network (``ops.bitonic``);
-    - ``pallas``: the Pallas VMEM tile-sort kernel (``ops.pallas_sort``).
+    - ``pallas``: the Pallas VMEM tile-sort kernel (``ops.pallas_sort``);
+    - ``radix``: the stable LSD counting-sort radix (``ops.radix``).
     """
     if kernel == "lax":
         return jnp.sort(keys, axis=-1)
@@ -81,6 +82,10 @@ def sort_with_kernel(keys: jax.Array, kernel: str = "lax") -> jax.Array:
         from dsort_tpu.ops.pallas_sort import pallas_sort
 
         return pallas_sort(keys)
+    if kernel == "radix":
+        from dsort_tpu.ops.radix import radix_sort
+
+        return radix_sort(keys)
     raise ValueError(f"unknown local kernel {kernel!r}; options: {LOCAL_KERNELS}")
 
 

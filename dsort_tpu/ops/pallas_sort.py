@@ -97,6 +97,190 @@ def _on_tpu() -> bool:
         return False
 
 
+def _tile_bitonic_kv_kernel(k_ref, v_ref, ok_ref, ov_ref, *, rows: int):
+    """Sort one (rows, 128) VMEM tile of (key, value) pairs, lexicographic.
+
+    Same network as `_tile_bitonic_kernel`, but each compare-exchange swaps
+    the pair based on ``(key, value)`` order.  The swap predicate is computed
+    from the pair's (first, second) members — identically on both sides of
+    the exchange — so equal keys make a consistent no-swap decision and no
+    payload is ever duplicated or lost; with value = global index the sort is
+    stable.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    k = k_ref[:]
+    v = v_ref[:]
+    n = rows * LANES
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+
+    def exchange(k, v, stage, d):
+        if d < LANES:
+            j, axis, idx, size = d, 1, lane, LANES
+        else:
+            j, axis, idx, size = d // LANES, 0, row, rows
+        pk = jnp.where(
+            (idx & j) == 0, pltpu.roll(k, size - j, axis), pltpu.roll(k, j, axis)
+        )
+        pv = jnp.where(
+            (idx & j) == 0, pltpu.roll(v, size - j, axis), pltpu.roll(v, j, axis)
+        )
+        am_first = (idx & j) == 0
+        fk, sk = jnp.where(am_first, k, pk), jnp.where(am_first, pk, k)
+        fv, sv = jnp.where(am_first, v, pv), jnp.where(am_first, pv, v)
+        first_gt = (fk > sk) | ((fk == sk) & (fv > sv))
+        asc = ((row * LANES + lane) & stage) == 0
+        swap = jnp.where(asc, first_gt, ~first_gt & ((fk != sk) | (fv != sv)))
+        return jnp.where(swap, pk, k), jnp.where(swap, pv, v)
+
+    stage = 2
+    while stage <= n:
+        d = stage // 2
+        while d >= 1:
+            k, v = exchange(k, v, stage, d)
+            d //= 2
+        stage *= 2
+    ok_ref[:] = k
+    ov_ref[:] = v
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "interpret"))
+def _tile_sort_kv(k2d: jax.Array, v2d: jax.Array, rows: int, interpret: bool):
+    """Pair-sort each consecutive (rows, 128) tile of (keys, values)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (k2d.shape[0] // rows,)
+    spec = lambda dt: pl.BlockSpec(
+        (rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        functools.partial(_tile_bitonic_kv_kernel, rows=rows),
+        out_shape=(
+            jax.ShapeDtypeStruct(k2d.shape, k2d.dtype),
+            jax.ShapeDtypeStruct(v2d.shape, v2d.dtype),
+        ),
+        grid=grid,
+        in_specs=[spec(k2d.dtype), spec(v2d.dtype)],
+        out_specs=(spec(k2d.dtype), spec(v2d.dtype)),
+        interpret=interpret,
+    )(k2d, v2d)
+
+
+def pallas_sort_kv(
+    keys: jax.Array,
+    payload: jax.Array,
+    tile_rows: int = 256,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Stable key+payload sort: Pallas (key, index) tile sorts + kv merge tree.
+
+    The payload never rides the compare-exchange network — only a global
+    int32 index does — so arbitrary payload widths (TeraSort's 90-byte
+    values) cost one final gather instead of O(log^2 n) exchange passes.
+    No key value is reserved: pads sort after real sentinel-valued keys by
+    the index tiebreak.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    n = keys.shape[0]
+    if n <= 1:
+        return keys, payload
+    tile = tile_rows * LANES
+    num_tiles = max(_ceil_pow2(-(-n // tile)), 1)
+    padded_n = num_tiles * tile
+    sent = sentinel_for(keys.dtype)
+    kp = jnp.concatenate([keys, jnp.full(padded_n - n, sent, dtype=keys.dtype)])
+    idx = jnp.arange(padded_n, dtype=jnp.int32)
+    ks, vs = _tile_sort_kv(
+        kp.reshape(-1, LANES), idx.reshape(-1, LANES), tile_rows, interpret
+    )
+    runs_k = ks.reshape(num_tiles, tile)
+    runs_v = vs.reshape(num_tiles, tile)
+    if num_tiles > 1:
+        from dsort_tpu.ops.bitonic import merge_sorted_runs_kv
+
+        out_k, perm = merge_sorted_runs_kv(runs_k, runs_v)
+    else:
+        out_k, perm = runs_k[0], runs_v[0]
+    from dsort_tpu.ops.local_sort import _apply_perm
+
+    return out_k[:n], _apply_perm(payload, perm[:n], 0)
+
+
+def _tile_histogram_kernel(x_ref, o_ref, *, shift: int, bits: int):
+    """Accumulate one tile's radix-digit histogram into a VMEM output block.
+
+    The SURVEY.md §7 "scatter-friendly histogramming in VMEM": counts are
+    full-tile compare+reduce per bucket (pure VPU), accumulated across the
+    sequential TPU grid into one (B/128, 128) block — no scatter anywhere.
+    """
+    from jax.experimental import pallas as pl
+
+    num_buckets = 1 << bits
+    out_rows = o_ref.shape[0]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    digits = (x_ref[:] >> shift) & (num_buckets - 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (out_rows, LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (out_rows, LANES), 0)
+    bucket_at = row * LANES + lane
+    acc = jnp.zeros((out_rows, LANES), jnp.int32)
+    for b in range(num_buckets):
+        cnt = jnp.sum((digits == b).astype(jnp.int32))
+        acc = acc + jnp.where(bucket_at == b, cnt, 0)
+    o_ref[:] = o_ref[:] + acc
+
+
+def radix_histogram(
+    x: jax.Array,
+    shift: int = 0,
+    bits: int = 8,
+    tile_rows: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Histogram of the radix digit ``(x >> shift) & (2^bits - 1)``, on-chip.
+
+    Returns an int32 ``(2^bits,)`` count vector.  Elements are processed in
+    (tile_rows, 128) VMEM tiles over a sequential grid; the input is padded
+    with zeros and the pad count is subtracted from bucket 0 of the pad
+    digit, so the result is exact for every length.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_buckets = 1 << bits
+    out_rows = max(num_buckets // LANES, 1)
+    n = x.shape[0]
+    tile = tile_rows * LANES
+    num_tiles = max(-(-n // tile), 1)
+    padded_n = num_tiles * tile
+    xp = jnp.concatenate([x, jnp.zeros(padded_n - n, dtype=x.dtype)])
+
+    out = pl.pallas_call(
+        functools.partial(_tile_histogram_kernel, shift=shift, bits=bits),
+        out_shape=jax.ShapeDtypeStruct((out_rows, LANES), jnp.int32),
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (out_rows, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(xp.reshape(-1, LANES))
+    hist = out.reshape(-1)[:num_buckets]
+    return hist.at[0].add(-(padded_n - n))  # zero pads all land in bucket 0
+
+
 def pallas_sort(
     x: jax.Array, tile_rows: int = 256, interpret: bool | None = None
 ) -> jax.Array:

@@ -1,0 +1,167 @@
+"""LSD radix sort — the radix kernel family of the framework (L0).
+
+The reference's only local kernel is the worker-side CPU merge sort
+(``client.c:140-173``, O(n log n) comparison sort with per-merge mallocs).
+This module provides the radix family named by ``BASELINE.json`` config #3:
+an LSD counting-sort radix, O(passes * n), structured for XLA/TPU:
+
+- **key mapping**: keys are bijected into an order-preserving unsigned
+  space (sign-bit flip for ints, sign-fold for floats), so one unsigned
+  digit loop serves int / uint / float keys of any width;
+- **blocked digit pass**: per-block one-hot histograms and within-block
+  stable ranks are computed as dense ``(block, B)`` cumsum work — lane-
+  friendly VPU shapes — with a ``lax.scan`` carrying the running global
+  histogram across blocks so peak memory is O(block * B), not O(n * B);
+- **stable permutation**: each pass applies one scatter with unique,
+  in-bounds destination indices; payloads ride the same permutation, so the
+  key+payload (TeraSort record) variant is the same code path.
+
+Stability makes sentinel padding exact even for key+payload sorts: pads sit
+at the input tail, so among equal (sentinel-valued) keys they sort last and
+trimming to the valid count never drops a real record — no key value is
+reserved, unlike the reference's in-band ``-1`` (``server.c:405-406``).
+
+Performance note (honest): on TPU the per-pass scatter is the weak spot —
+XLA lowers large dynamic scatters conservatively — so ``lax`` (XLA's fused
+bitonic-family sort) remains the default local kernel; ``radix`` is the
+algorithmically-linear alternative and the right base for payload-heavy
+records where comparison sorts pay to move payload through every
+compare-exchange stage.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_UINT = {8: jnp.uint8, 16: jnp.uint16, 32: jnp.uint32, 64: jnp.uint64}
+
+
+def _bit_width(dtype) -> int:
+    return jnp.dtype(dtype).itemsize * 8
+
+
+def _to_ordered_unsigned(x: jax.Array) -> jax.Array:
+    """Order-preserving bijection of any int/uint/float key into uintN."""
+    dtype = x.dtype
+    nbits = _bit_width(dtype)
+    u_dt = _UINT[nbits]
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        return x
+    u = lax.bitcast_convert_type(x, u_dt)
+    top = jnp.array(1 << (nbits - 1), u_dt)
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        return u ^ top
+    # Float: negative (sign bit set, i.e. u >= top) -> flip all bits so more-
+    # negative sorts first; non-negative -> set the sign bit to sort above.
+    allb = jnp.array((1 << nbits) - 1, u_dt)
+    return u ^ jnp.where(u >= top, allb, top)
+
+
+def _from_ordered_unsigned(u: jax.Array, dtype) -> jax.Array:
+    """Inverse of `_to_ordered_unsigned`."""
+    dtype = jnp.dtype(dtype)
+    nbits = _bit_width(dtype)
+    u_dt = _UINT[nbits]
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        return u.astype(dtype)
+    top = jnp.array(1 << (nbits - 1), u_dt)
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        return lax.bitcast_convert_type(u ^ top, dtype)
+    allb = jnp.array((1 << nbits) - 1, u_dt)
+    # Transformed non-negatives live in [top, allb]; negatives below top.
+    return lax.bitcast_convert_type(u ^ jnp.where(u >= top, top, allb), dtype)
+
+
+def _radix_pass(u, payloads, shift: int, bits: int, block: int):
+    """One stable counting-sort pass on digit ``(u >> shift) & (2^bits - 1)``."""
+    num_buckets = 1 << bits
+    n = u.shape[0]
+    digits = ((u >> shift) & (num_buckets - 1)).astype(jnp.int32)
+    dig_blocks = digits.reshape(n // block, block)
+    bucket_ids = jnp.arange(num_buckets, dtype=jnp.int32)
+
+    def body(base_hist, dig_blk):
+        onehot = (dig_blk[:, None] == bucket_ids[None, :]).astype(jnp.int32)
+        excl = jnp.cumsum(onehot, axis=0, dtype=jnp.int32) - onehot
+        rank_within = jnp.take_along_axis(excl, dig_blk[:, None], axis=1)[:, 0]
+        same_before = base_hist[dig_blk] + rank_within
+        return base_hist + onehot.sum(axis=0, dtype=jnp.int32), same_before
+
+    total_hist, same_before = lax.scan(
+        body, jnp.zeros(num_buckets, jnp.int32), dig_blocks
+    )
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(total_hist, dtype=jnp.int32)[:-1]]
+    )
+    dest = offsets[digits] + same_before.reshape(-1)
+    scatter = lambda a: jnp.zeros_like(a).at[dest].set(
+        a, unique_indices=True, mode="promise_in_bounds"
+    )
+    return scatter(u), tuple(scatter(p) for p in payloads)
+
+
+_MAX_BLOCK = 8192  # bounds the dense (block, B) per-pass intermediate
+
+
+def _radix_argapply(u, payloads, bits_per_pass: int):
+    """Run all digit passes; pads to a block multiple with the max key.
+
+    Stability parks the pad entries strictly last among equal keys, so
+    trimming back to ``n`` is exact even for key+payload sorts.
+    """
+    n = u.shape[0]
+    block = min(n, _MAX_BLOCK)
+    padded = -(-n // block) * block
+    if padded != n:
+        allb = jnp.array((1 << _bit_width(u.dtype)) - 1, u.dtype)
+        u = jnp.concatenate([u, jnp.full(padded - n, allb, u.dtype)])
+        payloads = tuple(
+            jnp.concatenate([p, jnp.zeros((padded - n,) + p.shape[1:], p.dtype)])
+            for p in payloads
+        )
+    nbits = _bit_width(u.dtype)
+    for shift in range(0, nbits, bits_per_pass):
+        bits = min(bits_per_pass, nbits - shift)
+        u, payloads = _radix_pass(u, payloads, shift, bits, block)
+    return u[:n], tuple(p[:n] for p in payloads)
+
+
+@functools.partial(jax.jit, static_argnames=("bits_per_pass",))
+def radix_sort(x: jax.Array, bits_per_pass: int = 8) -> jax.Array:
+    """Ascending stable LSD radix sort of a 1-D int/uint/float array.
+
+    NaNs (if any) sort above +inf with a deterministic bit-pattern order.
+    """
+    if x.ndim != 1:
+        raise ValueError(f"radix_sort takes a 1-D array, got shape {x.shape}")
+    if x.shape[0] <= 1:
+        return x
+    u, _ = _radix_argapply(_to_ordered_unsigned(x), (), bits_per_pass)
+    return _from_ordered_unsigned(u, x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bits_per_pass",))
+def radix_sort_kv(
+    keys: jax.Array, payload: jax.Array, bits_per_pass: int = 8
+) -> tuple[jax.Array, jax.Array]:
+    """Stable key+payload radix sort; payload rows follow their keys.
+
+    ``payload`` has shape ``(n,) + (...,)`` (e.g. TeraSort's 90-byte values
+    as ``(n, 90)`` uint8).  Stability means equal keys keep input order, so
+    sentinel-padded buffers trim exactly (see module docstring).
+    """
+    if keys.ndim != 1 or payload.shape[: 1] != keys.shape:
+        raise ValueError(
+            f"keys must be 1-D and payload leading dim must match: "
+            f"{keys.shape} vs {payload.shape}"
+        )
+    if keys.shape[0] <= 1:
+        return keys, payload
+    u, (out_v,) = _radix_argapply(
+        _to_ordered_unsigned(keys), (payload,), bits_per_pass
+    )
+    return _from_ordered_unsigned(u, keys.dtype), out_v

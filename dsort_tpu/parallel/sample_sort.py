@@ -134,7 +134,57 @@ def _sample_sort_shard(
     return merged, out_count[None], overflow[None]
 
 
-def _sample_sort_kv_shard(keys, payload, count, *, num_workers, oversample, cap_pair, axis):
+def _merge_received_kv(flat_k, is_pad, num_workers: int, cap_pair: int, merge_kernel: str):
+    """Sorted permutation of the received kv buffer: (sorted keys, gather perm).
+
+    Order is lexicographic on ``(key, is_pad, position)`` so real keys equal
+    to the sentinel keep their payloads (no reserved key values).  "sort"
+    re-sorts flat via ``lax.sort``; "bitonic" exploits that each received row
+    is already a sorted run and merges them with the kv bitonic merge tree,
+    carrying ``is_pad * total + position`` as the tiebreak value.
+    """
+    total = num_workers * cap_pair
+    idx = jnp.arange(total, dtype=jnp.int32)
+    if merge_kernel == "bitonic":
+        from dsort_tpu.ops.bitonic import _ceil_pow2, merge_sorted_runs_kv
+
+        sent = sentinel_for(flat_k.dtype)
+        tieb = is_pad.astype(jnp.int32) * total + idx  # pads after every real entry
+        runs_k = flat_k.reshape(num_workers, cap_pair)
+        runs_t = tieb.reshape(num_workers, cap_pair)
+        cap2 = _ceil_pow2(cap_pair)
+        r = _ceil_pow2(num_workers)
+        # Pad rows/length with (sentinel, ascending tieb >= 2*total) so every
+        # padded row stays sorted by (key, tieb) and pads trim off the tail.
+        if cap2 != cap_pair:
+            pad_t = 2 * total + jnp.broadcast_to(
+                jnp.arange(cap2 - cap_pair, dtype=jnp.int32), (num_workers, cap2 - cap_pair)
+            )
+            runs_k = jnp.concatenate(
+                [runs_k, jnp.full((num_workers, cap2 - cap_pair), sent, flat_k.dtype)], axis=1
+            )
+            runs_t = jnp.concatenate([runs_t, pad_t], axis=1)
+        if r != num_workers:
+            pad_t = 3 * total + jnp.broadcast_to(
+                jnp.arange(cap2, dtype=jnp.int32), (r - num_workers, cap2)
+            )
+            runs_k = jnp.concatenate(
+                [runs_k, jnp.full((r - num_workers, cap2), sent, flat_k.dtype)]
+            )
+            runs_t = jnp.concatenate([runs_t, pad_t])
+        merged_k, merged_t = merge_sorted_runs_kv(runs_k, runs_t)
+        out_k, tieb_out = merged_k[:total], merged_t[:total]
+        perm = jnp.where(tieb_out < total, tieb_out % total, 0)
+        return out_k, perm
+    is_pad8 = is_pad.astype(jnp.int8)
+    out_k, _, perm = jax.lax.sort((flat_k, is_pad8, idx), dimension=-1, num_keys=2)
+    return out_k, perm
+
+
+def _sample_sort_kv_shard(
+    keys, payload, count, *, num_workers, oversample, cap_pair, axis,
+    merge_kernel="sort",
+):
     """Key+payload variant (TeraSort records): payload rides the same shuffle."""
     from dsort_tpu.ops.local_sort import sort_kv_padded
 
@@ -148,14 +198,13 @@ def _sample_sort_kv_shard(keys, payload, count, *, num_workers, oversample, cap_
     recv_k = jax.lax.all_to_all(send_k, axis, split_axis=0, concat_axis=0)
     recv_v = jax.lax.all_to_all(send_v, axis, split_axis=0, concat_axis=0)
     lens_recv = jax.lax.all_to_all(lens[:, None], axis, split_axis=0, concat_axis=0)[:, 0]
-    # Re-derive validity after the exchange, then 2-key sort (key, is_pad) so
-    # real keys equal to the sentinel keep their payloads (no reserved keys).
+    # Re-derive validity after the exchange, then combine so real keys equal
+    # to the sentinel keep their payloads (no reserved keys).
     pos = jnp.arange(cap_pair, dtype=jnp.int32)[None, :]
-    is_pad = (pos >= lens_recv[:, None]).reshape(-1).astype(jnp.int8)
-    flat_k = jnp.where(is_pad.astype(bool), sent, recv_k.reshape(-1))
+    is_pad = (pos >= lens_recv[:, None]).reshape(-1)
+    flat_k = jnp.where(is_pad, sent, recv_k.reshape(-1))
     flat_v = recv_v.reshape((-1,) + recv_v.shape[2:])
-    idx = jnp.arange(flat_k.shape[0], dtype=jnp.int32)
-    out_k, _, perm = jax.lax.sort((flat_k, is_pad, idx), dimension=-1, num_keys=2)
+    out_k, perm = _merge_received_kv(flat_k, is_pad, num_workers, cap_pair, merge_kernel)
     from dsort_tpu.ops.local_sort import _apply_perm
 
     out_v = _apply_perm(flat_v, perm, 0)
@@ -196,7 +245,9 @@ class SampleSort:
             in_specs = (P(self.axis), P(self.axis))
             out_specs = (P(self.axis), P(self.axis), P(self.axis))
         else:
-            fn = functools.partial(_sample_sort_kv_shard, **kwargs)
+            fn = functools.partial(
+                _sample_sort_kv_shard, merge_kernel=self.job.merge_kernel, **kwargs
+            )
             in_specs = (P(self.axis), P(self.axis), P(self.axis))
             out_specs = (P(self.axis), P(self.axis), P(self.axis), P(self.axis))
         return jax.jit(

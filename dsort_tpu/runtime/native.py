@@ -134,13 +134,29 @@ def _run_ptrs(runs: list[np.ndarray]):
     return ptrs, lens
 
 
-def kway_merge(runs: list[np.ndarray]) -> np.ndarray:
-    """Heap k-way merge of sorted runs in native code."""
+def kway_merge(runs: list[np.ndarray], out: np.ndarray | None = None) -> np.ndarray:
+    """Heap k-way merge of sorted runs in native code.
+
+    ``out``, if given, receives the merge in place (it may be a disk-backed
+    ``np.memmap`` — the out-of-core egress path of `models.external_sort`).
+    """
     lib = _load()
     runs = [np.ascontiguousarray(r) for r in runs]
     dtype = runs[0].dtype
     fn = getattr(lib, _MERGE_FNS[dtype])
-    out = np.empty(sum(len(r) for r in runs), dtype=dtype)
+    total = sum(len(r) for r in runs)
+    if out is None:
+        out = np.empty(total, dtype=dtype)
+    elif (
+        len(out) != total
+        or out.dtype != dtype
+        or not out.flags.c_contiguous
+        or not out.flags.writeable
+    ):
+        raise ValueError(
+            f"out must be writable C-contiguous {dtype}[{total}], "
+            f"got {out.dtype}[{len(out)}]"
+        )
     ptrs, lens = _run_ptrs(runs)
     fn(ptrs, lens, len(runs), out.ctypes.data_as(ctypes.c_void_p))
     return out

@@ -1,0 +1,155 @@
+"""Out-of-core external sort: correctness, resume, file-to-file path."""
+
+import numpy as np
+import pytest
+
+from dsort_tpu.models.external_sort import ExternalSort
+from dsort_tpu.utils.metrics import Metrics
+
+
+@pytest.mark.parametrize("n,run", [(0, 64), (1, 64), (100, 64), (1000, 128), (4096, 512)])
+def test_external_matches_oracle(tmp_path, n, run):
+    rng = np.random.default_rng(n)
+    data = rng.integers(-(2**31), 2**31 - 1, n, dtype=np.int64).astype(np.int32)
+    s = ExternalSort(run_elems=run, spill_dir=str(tmp_path), job_id=f"t{n}")
+    np.testing.assert_array_equal(s.sort(data), np.sort(data))
+
+
+def test_external_partial_run_with_sentinel_keys(tmp_path):
+    # Final partial run trim must not drop real max-valued keys.
+    sent = np.iinfo(np.int32).max
+    data = np.array([5, sent, 1, sent, 3, 2, 7, sent, 0], dtype=np.int32)
+    s = ExternalSort(run_elems=4, spill_dir=str(tmp_path), job_id="sent")
+    np.testing.assert_array_equal(s.sort(data), np.sort(data))
+
+
+def test_external_resume_skips_finished_runs(tmp_path):
+    rng = np.random.default_rng(7)
+    data = rng.integers(-1000, 1000, 1000).astype(np.int32)
+    s1 = ExternalSort(run_elems=100, spill_dir=str(tmp_path), job_id="resume")
+    m1 = Metrics()
+    np.testing.assert_array_equal(s1.sort(data, metrics=m1), np.sort(data))
+    assert m1.counters["runs_sorted"] == 10
+    # Second pass over the same job id re-sorts nothing.
+    s2 = ExternalSort(run_elems=100, spill_dir=str(tmp_path), job_id="resume")
+    m2 = Metrics()
+    np.testing.assert_array_equal(s2.sort(data, metrics=m2), np.sort(data))
+    assert m2.counters.get("runs_sorted", 0) == 0
+    assert m2.counters["runs_resumed"] == 10
+    # resume=False clears and redoes the work.
+    s3 = ExternalSort(
+        run_elems=100, spill_dir=str(tmp_path), job_id="resume", resume=False
+    )
+    m3 = Metrics()
+    np.testing.assert_array_equal(s3.sort(data, metrics=m3), np.sort(data))
+    assert m3.counters["runs_sorted"] == 10
+
+
+def test_external_partial_resume_after_simulated_crash(tmp_path):
+    # Kill the job after 3 runs; the retry sorts only the remaining 7
+    # (SURVEY.md §5.4: strictly better than the reference's restart-the-chunk).
+    rng = np.random.default_rng(8)
+    data = rng.integers(-1000, 1000, 700).astype(np.int32)
+    s = ExternalSort(run_elems=100, spill_dir=str(tmp_path), job_id="crash")
+
+    calls = {"n": 0}
+    orig = s._sort_run
+
+    def dying(chunk):
+        if calls["n"] == 3:
+            raise RuntimeError("injected crash")
+        calls["n"] += 1
+        return orig(chunk)
+
+    s._sort_run = dying
+    with pytest.raises(RuntimeError, match="injected crash"):
+        s.sort(data)
+    s._sort_run = orig
+    m = Metrics()
+    np.testing.assert_array_equal(s.sort(data, metrics=m), np.sort(data))
+    assert m.counters["runs_resumed"] == 3
+    assert m.counters["runs_sorted"] == 4
+
+
+def test_external_binary_file_roundtrip(tmp_path):
+    rng = np.random.default_rng(9)
+    data = rng.integers(-(2**31), 2**31 - 1, 5000, dtype=np.int64).astype(np.int32)
+    in_path = str(tmp_path / "in.bin")
+    out_path = str(tmp_path / "out.bin")
+    data.tofile(in_path)
+    s = ExternalSort(run_elems=1024, spill_dir=str(tmp_path / "spill"), job_id="file")
+    m = Metrics()
+    s.sort_binary_file(in_path, out_path, dtype=np.int32, metrics=m)
+    out = np.fromfile(out_path, dtype=np.int32)
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_external_output_into_memmap(tmp_path):
+    rng = np.random.default_rng(10)
+    data = rng.integers(0, 10**6, 2000).astype(np.uint32)
+    out_path = str(tmp_path / "out.raw")
+    out = np.memmap(out_path, dtype=np.uint32, mode="w+", shape=(2000,))
+    s = ExternalSort(run_elems=256, spill_dir=str(tmp_path / "spill"), job_id="mm")
+    res = s.sort(data, out=out)
+    assert res is out
+    out.flush()
+    np.testing.assert_array_equal(
+        np.fromfile(out_path, dtype=np.uint32), np.sort(data)
+    )
+
+
+def test_cli_external_subcommand(tmp_path):
+    from dsort_tpu.cli import main as cli_main
+
+    rng = np.random.default_rng(11)
+    data = rng.integers(-(2**31), 2**31 - 1, 3000, dtype=np.int64).astype(np.int32)
+    in_path, out_path = str(tmp_path / "in.bin"), str(tmp_path / "out.bin")
+    data.tofile(in_path)
+    rc = cli_main([
+        "external", in_path, "-o", out_path,
+        "--run-elems", "512", "--spill-dir", str(tmp_path / "spill"),
+    ])
+    assert rc == 0
+    np.testing.assert_array_equal(np.fromfile(out_path, dtype=np.int32), np.sort(data))
+
+
+def test_external_reused_job_id_detects_different_data(tmp_path):
+    # A reused job_id with different data must NOT return the old output
+    # (the manifest fingerprint invalidates stale runs).
+    rng = np.random.default_rng(12)
+    a = rng.integers(-1000, 1000, 500).astype(np.int32)
+    b = rng.integers(-1000, 1000, 500).astype(np.int32)
+    s = ExternalSort(run_elems=100, spill_dir=str(tmp_path), job_id="same")
+    np.testing.assert_array_equal(s.sort(a), np.sort(a))
+    np.testing.assert_array_equal(s.sort(b), np.sort(b))
+    # Different run_elems over the same data is also detected.
+    s2 = ExternalSort(run_elems=250, spill_dir=str(tmp_path), job_id="same")
+    np.testing.assert_array_equal(s2.sort(b), np.sort(b))
+
+
+def test_external_single_run_result_is_owned(tmp_path):
+    data = np.array([3, 1, 2], dtype=np.int32)
+    s = ExternalSort(run_elems=100, spill_dir=str(tmp_path), job_id="own")
+    out = s.sort(data)
+    assert out.flags.writeable
+    out[0] = 7  # must not raise or corrupt checkpoint state
+
+
+def test_external_empty_binary_file(tmp_path):
+    in_path, out_path = str(tmp_path / "e.bin"), str(tmp_path / "e.out")
+    open(in_path, "wb").close()
+    s = ExternalSort(run_elems=64, spill_dir=str(tmp_path / "spill"), job_id="e")
+    s.sort_binary_file(in_path, out_path, dtype=np.int32)
+    assert np.fromfile(out_path, dtype=np.int32).size == 0
+
+
+def test_native_merge_rejects_readonly_out(tmp_path):
+    from dsort_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    runs = [np.array([1, 3], dtype=np.int32), np.array([2, 4], dtype=np.int32)]
+    ro = np.zeros(4, dtype=np.int32)
+    ro.setflags(write=False)
+    with pytest.raises(ValueError, match="writable"):
+        native.kway_merge(runs, out=ro)

@@ -1,0 +1,70 @@
+"""Pallas key+payload tile sort and VMEM radix histogram (interpret mode).
+
+These run the real kernels under the Pallas interpreter on the CPU test
+mesh; on TPU the identical code lowers to Mosaic (SURVEY.md §4 strategy:
+distributed/TPU behavior exercised without the hardware).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsort_tpu.ops.pallas_sort import pallas_sort_kv, radix_histogram
+
+# Tiny tiles so multi-tile paths (merge tree, grid accumulation) are hit.
+TR = 2  # tile_rows -> tile of 256 elements
+
+
+@pytest.mark.parametrize("n", [1, 5, 255, 256, 257, 1000, 2048])
+def test_pallas_kv_matches_stable_oracle(n):
+    rng = np.random.default_rng(n)
+    keys = rng.integers(-50, 50, n).astype(np.int32)  # many duplicates
+    payload = np.arange(n, dtype=np.int32)
+    out_k, out_v = pallas_sort_kv(
+        jnp.asarray(keys), jnp.asarray(payload), tile_rows=TR
+    )
+    perm = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(np.asarray(out_k), keys[perm])
+    np.testing.assert_array_equal(np.asarray(out_v), perm)
+
+
+def test_pallas_kv_wide_payload():
+    rng = np.random.default_rng(0)
+    n = 700
+    keys = rng.integers(-(2**31), 2**31 - 1, n, dtype=np.int64).astype(np.int32)
+    payload = rng.integers(0, 256, (n, 9)).astype(np.uint8)  # TeraSort-like rows
+    out_k, out_v = pallas_sort_kv(
+        jnp.asarray(keys), jnp.asarray(payload), tile_rows=TR
+    )
+    perm = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(np.asarray(out_k), keys[perm])
+    np.testing.assert_array_equal(np.asarray(out_v), payload[perm])
+
+
+def test_pallas_kv_sentinel_keys_not_reserved():
+    # Real keys equal to the padding sentinel must survive (the reference
+    # reserves -1 on its wire, server.c:405-406; we reserve nothing).
+    sent = np.iinfo(np.int32).max
+    keys = np.array([5, sent, 1, sent, 3], dtype=np.int32)
+    payload = np.array([50, 51, 52, 53, 54], dtype=np.int32)
+    out_k, out_v = pallas_sort_kv(jnp.asarray(keys), jnp.asarray(payload), tile_rows=TR)
+    np.testing.assert_array_equal(np.asarray(out_k), [1, 3, 5, sent, sent])
+    np.testing.assert_array_equal(np.asarray(out_v), [52, 54, 50, 51, 53])
+
+
+@pytest.mark.parametrize("shift,bits", [(0, 8), (8, 8), (24, 8), (0, 4)])
+def test_radix_histogram_exact(shift, bits):
+    rng = np.random.default_rng(shift + bits)
+    x = rng.integers(0, 2**31 - 1, 3000, dtype=np.int64).astype(np.int32)
+    hist = np.asarray(radix_histogram(jnp.asarray(x), shift, bits, tile_rows=TR))
+    digits = (x >> shift) & ((1 << bits) - 1)
+    expected = np.bincount(digits, minlength=1 << bits)
+    np.testing.assert_array_equal(hist, expected)
+    assert hist.sum() == len(x)
+
+
+def test_radix_histogram_pad_correction():
+    # n not a tile multiple and lots of real zeros: pad subtraction is exact.
+    x = np.zeros(77, dtype=np.int32)
+    hist = np.asarray(radix_histogram(jnp.asarray(x), 0, 8, tile_rows=TR))
+    assert hist[0] == 77 and hist[1:].sum() == 0

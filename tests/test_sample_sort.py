@@ -122,3 +122,32 @@ def test_sample_sort_bitonic_merge_on_7_device_mesh():
     data = gen_uniform(10_000, seed=62)
     out = SampleSort(mesh7, JobConfig(merge_kernel="bitonic")).sort(data)
     np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_sample_sort_kv_bitonic_merge_kernel(mesh8):
+    # merge_kernel applies to the kv path too (bitonic kv merge tree of the
+    # received sorted runs) and must keep every record.
+    from dsort_tpu.data.ingest import gen_terasort
+
+    keys, payload = gen_terasort(8_000, seed=23)
+    job = JobConfig(key_dtype=np.uint64, merge_kernel="bitonic")
+    sk, sv = SampleSort(mesh8, job).sort_kv(keys, payload)
+    np.testing.assert_array_equal(sk, np.sort(keys))
+    assert sorted(zip(sk.tolist(), map(bytes, sv))) == sorted(
+        zip(keys.tolist(), map(bytes, payload))
+    )
+
+
+def test_sample_sort_kv_bitonic_sentinel_keys(mesh8):
+    # Real sentinel-valued keys must keep their payloads under both combines.
+    sent = np.iinfo(np.int32).max
+    rng = np.random.default_rng(29)
+    keys = rng.integers(-100, 100, 3_000).astype(np.int32)
+    keys[::97] = sent
+    payload = rng.integers(0, 255, (3_000, 3)).astype(np.uint8)
+    for mk in ("sort", "bitonic"):
+        sk, sv = SampleSort(mesh8, JobConfig(merge_kernel=mk)).sort_kv(keys, payload)
+        np.testing.assert_array_equal(sk, np.sort(keys))
+        assert sorted(zip(sk.tolist(), map(bytes, sv))) == sorted(
+            zip(keys.tolist(), map(bytes, payload))
+        )
