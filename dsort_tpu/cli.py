@@ -178,7 +178,11 @@ def cmd_terasort(args) -> int:
     """Sort a binary TeraSort record file (BASELINE config #4)."""
     import jax
 
-    from dsort_tpu.data.ingest import read_terasort_file, write_terasort_file
+    from dsort_tpu.data.ingest import (
+        read_terasort_file,
+        terasort_secondary,
+        write_terasort_file,
+    )
     from dsort_tpu.parallel.mesh import local_device_mesh
     from dsort_tpu.parallel.sample_sort import SampleSort
     from dsort_tpu.config import JobConfig
@@ -188,7 +192,9 @@ def cmd_terasort(args) -> int:
     job = JobConfig(key_dtype=np.uint64, payload_bytes=payload.shape[1])
     metrics = Metrics()
     t0 = time.perf_counter()
-    sk, sv = SampleSort(mesh, job).sort_kv(keys, payload, metrics=metrics)
+    sk, sv = SampleSort(mesh, job).sort_kv(
+        keys, payload, metrics=metrics, secondary=terasort_secondary(payload)
+    )
     dt = time.perf_counter() - t0
     write_terasort_file(args.output or "terasort_out.bin", sk, sv)
     log.info(

@@ -47,17 +47,33 @@ def read_terasort_file(path: str | os.PathLike) -> tuple[np.ndarray, np.ndarray]
 
     Records are 100 bytes.  The first 8 key bytes pack big-endian into a
     uint64 sort key; the remaining 92 bytes (2 key bytes + 90 value bytes)
-    ride as payload, so full records are preserved byte-exactly.
+    ride as payload, so full records are preserved byte-exactly.  Key bytes
+    8-9 sit in ``payload[:, :2]`` — `terasort_secondary` turns them into the
+    tiebreak key that completes the full 10-byte ordering.
     """
     raw = np.fromfile(path, dtype=np.uint8)
     if len(raw) % RECORD_BYTES:
         raise ValueError(f"{path}: size {len(raw)} not a multiple of {RECORD_BYTES}")
     raw = raw.reshape(-1, RECORD_BYTES)
-    keys = raw[:, :8].astype(np.uint64)
-    packed = np.zeros(len(raw), dtype=np.uint64)
-    for b in range(8):
-        packed = (packed << np.uint64(8)) | keys[:, b]
+    packed = _pack_be64(raw[:, :8])
     return packed, raw[:, 8:].copy()
+
+
+def _pack_be64(key_bytes: np.ndarray) -> np.ndarray:
+    """(n, 8) uint8 big-endian rows -> native uint64 (one vectorized view)."""
+    return (
+        np.ascontiguousarray(key_bytes).view(">u8").reshape(-1).astype(np.uint64)
+    )
+
+
+def terasort_secondary(payload: np.ndarray) -> np.ndarray:
+    """Tiebreak key from a TeraSort payload: key bytes 8-9, big-endian uint16.
+
+    Sorting by ``(packed_keys, terasort_secondary(payload))`` orders records
+    by the full 10-byte TeraSort key; the 8-byte prefix alone leaves records
+    with colliding prefixes in arbitrary relative order.
+    """
+    return (payload[:, 0].astype(np.uint16) << np.uint16(8)) | payload[:, 1]
 
 
 def write_terasort_file(
@@ -85,15 +101,11 @@ def gen_terasort(
     """TeraSort-style records (BASELINE config #4).
 
     Returns ``(keys, payloads)``: keys are the first 8 bytes of the 10-byte
-    key interpreted big-endian as uint64 (sorting by this 8-byte prefix is
-    byte-order-equivalent for random data; full 10-byte tie-breaking is done
-    by carrying the remaining bytes in the payload), payloads are
-    ``(n, key_bytes - 8 + payload_bytes)`` uint8.
+    key interpreted big-endian as uint64; payloads are
+    ``(n, key_bytes - 8 + payload_bytes)`` uint8 whose first two columns are
+    key bytes 8-9.  Pass ``terasort_secondary(payloads)`` as the sort's
+    secondary key to order by the full 10-byte key.
     """
     rng = np.random.default_rng(seed)
     raw = rng.integers(0, 256, size=(n, key_bytes + payload_bytes), dtype=np.uint8)
-    keys = raw[:, :8].astype(np.uint64)
-    packed = np.zeros(n, dtype=np.uint64)
-    for b in range(8):
-        packed = (packed << np.uint64(8)) | keys[:, b]
-    return packed, raw[:, 8:]
+    return _pack_be64(raw[:, :8]), raw[:, 8:]

@@ -52,6 +52,23 @@ def pad_to_shards(
     return out, np.asarray(sizes, dtype=np.int32)
 
 
+def pad_to_layout(
+    data: np.ndarray, counts: np.ndarray, cap: int, fill=0
+) -> np.ndarray:
+    """Lay ``data`` out as ``(len(counts), cap)`` using precomputed shard sizes.
+
+    Companion channels (e.g. a secondary sort key) reuse the sizes/cap a prior
+    `pad_to_shards`/`pad_kv_to_shards` call computed, instead of re-partitioning.
+    Pads hold ``fill``.
+    """
+    out = np.full((len(counts), cap) + data.shape[1:], fill, dtype=data.dtype)
+    off = 0
+    for i, s in enumerate(np.asarray(counts)):
+        out[i, :s] = data[off : off + s]
+        off += s
+    return out
+
+
 def pad_kv_to_shards(
     keys: np.ndarray, payload: np.ndarray, num_workers: int, multiple: int = 8
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

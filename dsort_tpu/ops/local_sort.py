@@ -104,6 +104,43 @@ def sort_padded(
     return sort_with_kernel(masked, kernel), jnp.asarray(count, jnp.int32)
 
 
+def sort_kv2_padded(
+    keys: jax.Array,
+    secondary: jax.Array,
+    payload: jax.Array,
+    count: jax.Array | int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Two-level key+payload `sort_padded`: order is ``(key, secondary)``.
+
+    For records whose sort key is wider than one machine word (TeraSort's
+    10-byte keys: 8-byte ``keys`` prefix + 2-byte ``secondary`` tail), ties in
+    the primary key are broken by ``secondary``.  Pads still sort after every
+    real record — including real records whose (key, secondary) equals the
+    sentinel pair — via the is-pad tiebreak, so no key value is reserved.
+    Returns ``(keys, secondary, payload, count)``, all sorted together.
+    """
+    pos = jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1)
+    is_pad = (pos >= count).astype(jnp.int8)
+    masked = jnp.where(pos < count, keys, sentinel_for(keys.dtype))
+    if payload.ndim == keys.ndim:
+        out_k, _, out_s, out_v = jax.lax.sort(
+            (masked, is_pad, secondary, payload), dimension=-1, num_keys=3
+        )
+        return out_k, out_s, out_v, jnp.asarray(count, jnp.int32)
+    idx = jnp.broadcast_to(
+        jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1), keys.shape
+    )
+    out_k, _, out_s, perm = jax.lax.sort(
+        (masked, is_pad, secondary, idx), dimension=-1, num_keys=3
+    )
+    return (
+        out_k,
+        out_s,
+        _apply_perm(payload, perm, keys.ndim - 1),
+        jnp.asarray(count, jnp.int32),
+    )
+
+
 def sort_kv_padded(
     keys: jax.Array, payload: jax.Array, count: jax.Array | int
 ) -> tuple[jax.Array, jax.Array, jax.Array]:

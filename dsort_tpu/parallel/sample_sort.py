@@ -181,16 +181,26 @@ def _merge_received_kv(flat_k, is_pad, num_workers: int, cap_pair: int, merge_ke
     return out_k, perm
 
 
-def _sample_sort_kv_shard(
-    keys, payload, count, *, num_workers, oversample, cap_pair, axis,
+def _kv_shard_body(
+    keys, payload, sec, count, *, num_workers, oversample, cap_pair, axis,
     merge_kernel="sort",
 ):
-    """Key+payload variant (TeraSort records): payload rides the same shuffle."""
-    from dsort_tpu.ops.local_sort import sort_kv_padded
+    """Shared kv shuffle body; ``sec`` is an optional (static) tiebreak array.
+
+    With ``sec=None`` this is the plain key+payload sort; with a secondary
+    the record order is ``(key, sec)`` and the secondary rides the shuffle
+    next to the payload (the combine then always uses ``lax.sort`` — the
+    bitonic kv merge tree carries a single tiebreak channel, which the
+    (is_pad, sec, position) triple would overflow).
+    """
+    from dsort_tpu.ops.local_sort import _apply_perm, sort_kv2_padded, sort_kv_padded
 
     sent = sentinel_for(keys.dtype)
     count = count[0]
-    keys, payload, _ = sort_kv_padded(keys, payload, count)
+    if sec is None:
+        keys, payload, _ = sort_kv_padded(keys, payload, count)
+    else:
+        keys, sec, payload, _ = sort_kv2_padded(keys, sec, payload, count)
     splitters = _choose_splitters(keys, count, num_workers, oversample, axis)
     gidx, valid, lens, overflow = _bucket_slices(keys, count, splitters, cap_pair)
     send_k = jnp.where(valid, keys[gidx], sent)
@@ -204,12 +214,39 @@ def _sample_sort_kv_shard(
     is_pad = (pos >= lens_recv[:, None]).reshape(-1)
     flat_k = jnp.where(is_pad, sent, recv_k.reshape(-1))
     flat_v = recv_v.reshape((-1,) + recv_v.shape[2:])
-    out_k, perm = _merge_received_kv(flat_k, is_pad, num_workers, cap_pair, merge_kernel)
-    from dsort_tpu.ops.local_sort import _apply_perm
-
-    out_v = _apply_perm(flat_v, perm, 0)
     out_count = jnp.sum(lens_recv).astype(jnp.int32)
-    return out_k, out_v, out_count[None], overflow[None]
+    if sec is None:
+        out_k, perm = _merge_received_kv(
+            flat_k, is_pad, num_workers, cap_pair, merge_kernel
+        )
+        out_v = _apply_perm(flat_v, perm, 0)
+        return out_k, out_v, out_count[None], overflow[None]
+    recv_s = jax.lax.all_to_all(sec[gidx], axis, split_axis=0, concat_axis=0)
+    idx = jnp.arange(num_workers * cap_pair, dtype=jnp.int32)
+    out_k, _, out_s, perm = jax.lax.sort(
+        (flat_k, is_pad.astype(jnp.int8), recv_s.reshape(-1), idx),
+        dimension=-1,
+        num_keys=3,
+    )
+    out_v = _apply_perm(flat_v, perm, 0)
+    return out_k, out_s, out_v, out_count[None], overflow[None]
+
+
+def _sample_sort_kv_shard(keys, payload, count, **kw):
+    """Key+payload variant (TeraSort records): payload rides the same shuffle."""
+    return _kv_shard_body(keys, payload, None, count, **kw)
+
+
+def _sample_sort_kv2_shard(keys, sec, payload, count, **kw):
+    """Two-level-key variant: records order by ``(key, sec)`` (TeraSort's full
+    10-byte key = 8-byte primary + 2-byte secondary; SURVEY.md §6 config #4).
+
+    Splitters come from the primary key only — every record with the same
+    primary lands in the same bucket (`_bucket_slices` is side='left'
+    consistent), so breaking primary ties by ``sec`` locally inside each
+    destination yields the exact global order.
+    """
+    return _kv_shard_body(keys, payload, sec, count, **kw)
 
 
 class SampleSort:
@@ -226,7 +263,9 @@ class SampleSort:
         self.num_workers = mesh.shape[axis_name]
 
     @functools.lru_cache(maxsize=32)
-    def _build(self, n_local: int, cap_pair: int, kv_trailing: tuple):
+    def _build(
+        self, n_local: int, cap_pair: int, kv_trailing: tuple, secondary: bool = False
+    ):
         """Compile the shard_map'd program for one (shape, capacity) combo."""
         p = self.num_workers
         kwargs = dict(
@@ -244,6 +283,12 @@ class SampleSort:
             )
             in_specs = (P(self.axis), P(self.axis))
             out_specs = (P(self.axis), P(self.axis), P(self.axis))
+        elif secondary:
+            fn = functools.partial(
+                _sample_sort_kv2_shard, merge_kernel=self.job.merge_kernel, **kwargs
+            )
+            in_specs = (P(self.axis),) * 4
+            out_specs = (P(self.axis),) * 5
         else:
             fn = functools.partial(
                 _sample_sort_kv_shard, merge_kernel=self.job.merge_kernel, **kwargs
@@ -307,8 +352,22 @@ class SampleSort:
         keys: np.ndarray,
         payload: np.ndarray,
         metrics: Metrics | None = None,
+        secondary: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
-        """TeraSort-style key+payload sort; payloads follow their keys."""
+        """TeraSort-style key+payload sort; payloads follow their keys.
+
+        ``secondary`` (optional, same length as ``keys``) breaks primary-key
+        ties, so sort keys wider than one machine word — TeraSort's 10-byte
+        key as an 8-byte primary + 2-byte secondary — order exactly instead
+        of relying on prefix uniqueness.  With a secondary the combine always
+        uses the ``lax.sort`` merge; ``JobConfig.merge_kernel='bitonic'`` is
+        ignored on this path (warned once below).
+        """
+        if secondary is not None and self.job.merge_kernel == "bitonic":
+            log.warning(
+                "merge_kernel='bitonic' is not available with a secondary key; "
+                "using the lax.sort combine"
+            )
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         p = self.num_workers
@@ -324,13 +383,25 @@ class SampleSort:
                 NamedSharding(self.mesh, P(self.axis)),
             )
             cj = jax.device_put(jnp.asarray(counts), NamedSharding(self.mesh, P(self.axis)))
+            if secondary is not None:
+                from dsort_tpu.data.partition import pad_to_layout
+
+                ss = pad_to_layout(secondary, counts, sk.shape[1])
+                sj = jax.device_put(
+                    jnp.asarray(ss).reshape(-1), NamedSharding(self.mesh, P(self.axis))
+                )
         n_local = sk.shape[1]
         factor = self.job.capacity_factor
         for attempt in range(self.job.max_capacity_retries + 1):
             cap_pair = self._cap_pair(n_local, factor)
-            fn = self._build(n_local, cap_pair, tuple(sv.shape[2:]))
+            fn = self._build(
+                n_local, cap_pair, tuple(sv.shape[2:]), secondary is not None
+            )
             with timer.phase("spmd_sort"):
-                out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
+                if secondary is not None:
+                    out_k, _, out_v, out_counts, overflow = fn(xs, sj, vs, cj)
+                else:
+                    out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
                 out_k.block_until_ready()
             if not bool(np.asarray(overflow).any()):
                 break

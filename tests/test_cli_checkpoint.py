@@ -107,9 +107,17 @@ def test_cli_terasort_binary_roundtrip(tmp_path):
     inp, outp = tmp_path / "t.bin", tmp_path / "t_out.bin"
     assert cli_main(["gen", "2000", "-o", str(inp), "--dist", "terasort"]) == 0
     assert cli_main(["terasort", str(inp), "-o", str(outp), "--workers", "8"]) == 0
+    from dsort_tpu.data.ingest import terasort_secondary
+
     k_in, v_in = read_terasort_file(inp)
     k_out, v_out = read_terasort_file(outp)
     np.testing.assert_array_equal(k_out, np.sort(k_in))
+    # output is ordered by the FULL 10-byte key (secondary breaks prefix ties)
+    s_out = terasort_secondary(v_out)
+    lex_ok = (k_out[1:] > k_out[:-1]) | (
+        (k_out[1:] == k_out[:-1]) & (s_out[1:] >= s_out[:-1])
+    )
+    assert lex_ok.all()
     # full records preserved as a multiset
     assert sorted(zip(k_out.tolist(), map(bytes, v_out))) == sorted(
         zip(k_in.tolist(), map(bytes, v_in))

@@ -102,3 +102,48 @@ def test_sort_kv_batched_payload():
         order = np.argsort(keys[b], kind="stable")
         np.testing.assert_array_equal(np.asarray(k)[b], keys[b][order])
         np.testing.assert_array_equal(np.asarray(v)[b], vals[b][order])
+
+
+def test_sort_kv2_padded_orders_by_secondary():
+    from dsort_tpu.ops.local_sort import sort_kv2_padded
+
+    # Primary collides everywhere: secondary must decide; pads trim exactly.
+    keys = np.array([5, 5, 5, 5, 999], dtype=np.int32)
+    sec = np.array([30, 10, 20, 10, 0], dtype=np.int32)
+    vals = np.array([[3], [1], [2], [9], [0]], dtype=np.uint8)
+    k, s, v, count = sort_kv2_padded(
+        jnp.asarray(keys), jnp.asarray(sec), jnp.asarray(vals), 4
+    )
+    np.testing.assert_array_equal(np.asarray(k)[:4], [5, 5, 5, 5])
+    np.testing.assert_array_equal(np.asarray(s)[:4], [10, 10, 20, 30])
+    assert sorted(np.asarray(v)[:4, 0].tolist()) == [1, 2, 3, 9]
+    assert set(np.asarray(v)[:2, 0].tolist()) == {1, 9}  # the two sec=10 rows
+    assert int(count) == 4
+
+
+def test_sort_kv2_padded_sentinel_key_real_record_survives():
+    from dsort_tpu.ops.local_sort import sort_kv2_padded
+
+    m = np.iinfo(np.int32).max
+    keys = np.array([m, m, 1, 777], dtype=np.int32)  # last entry is garbage pad
+    sec = np.array([2, 1, 0, 0], dtype=np.int32)
+    vals = np.array([20, 10, 5, 0], dtype=np.int32)
+    k, s, v, _ = sort_kv2_padded(jnp.asarray(keys), jnp.asarray(sec), jnp.asarray(vals), 3)
+    np.testing.assert_array_equal(np.asarray(k)[:3], [1, m, m])
+    np.testing.assert_array_equal(np.asarray(s)[:3], [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(v)[:3], [5, 10, 20])
+
+
+def test_terasort_pack_and_secondary_roundtrip():
+    from dsort_tpu.data.ingest import _pack_be64, terasort_secondary
+
+    rows = np.array(
+        [[0, 0, 0, 0, 0, 0, 0, 1], [255] * 8, [1, 2, 3, 4, 5, 6, 7, 8]],
+        dtype=np.uint8,
+    )
+    packed = _pack_be64(rows)
+    assert packed.dtype == np.uint64 and packed[0] == 1
+    assert packed[1] == np.uint64(0xFFFFFFFFFFFFFFFF)
+    assert packed[2] == np.uint64(0x0102030405060708)
+    payload = np.array([[0xAB, 0xCD, 7], [0, 1, 9]], dtype=np.uint8)
+    np.testing.assert_array_equal(terasort_secondary(payload), [0xABCD, 0x0001])

@@ -78,6 +78,48 @@ def test_sample_sort_kv_duplicate_keys_keep_payloads(mesh8):
     )
 
 
+def test_sample_sort_kv_full_10byte_key_order(mesh8):
+    # TeraSort's real contract: order by the FULL 10-byte key.  Force heavy
+    # 8-byte-prefix collisions so the 2-byte secondary must do the ordering.
+    from dsort_tpu.data.ingest import terasort_secondary
+
+    rng = np.random.default_rng(23)
+    n = 6_000
+    keys = rng.integers(0, 16, n).astype(np.uint64)  # ~375 records per prefix
+    payload = rng.integers(0, 256, (n, 92), dtype=np.uint8)
+    sec = terasort_secondary(payload)
+    sk, sv = SampleSort(mesh8, JobConfig(key_dtype=np.uint64)).sort_kv(
+        keys, payload, secondary=sec
+    )
+    ssec = terasort_secondary(sv)
+    # (key, secondary) pairs are globally nondecreasing lexicographically...
+    pairs = sk.astype(np.uint64) * (1 << 16) + ssec.astype(np.uint64)
+    assert (np.diff(pairs.astype(np.int64)) >= 0).all()
+    # ...and the full records are a permutation of the input.
+    assert sorted(zip(sk.tolist(), map(bytes, sv))) == sorted(
+        zip(keys.tolist(), map(bytes, payload))
+    )
+
+
+def test_sample_sort_kv_secondary_with_capacity_retry(mesh8):
+    # All-equal primaries overflow one bucket; the kv2 path must retry and
+    # still produce exact (key, secondary) order.
+    from dsort_tpu.data.ingest import terasort_secondary
+
+    rng = np.random.default_rng(29)
+    n = 4_000
+    keys = np.zeros(n, dtype=np.uint64)
+    payload = rng.integers(0, 256, (n, 8), dtype=np.uint8)
+    sec = terasort_secondary(payload)
+    m = Metrics()
+    sk, sv = SampleSort(
+        mesh8, JobConfig(key_dtype=np.uint64, capacity_factor=1.0)
+    ).sort_kv(keys, payload, metrics=m, secondary=sec)
+    assert m.counters.get("capacity_retries", 0) >= 1
+    assert (np.diff(terasort_secondary(sv).astype(np.int64)) >= 0).all()
+    assert sorted(map(bytes, sv)) == sorted(map(bytes, payload))
+
+
 @pytest.mark.parametrize("dtype", [np.uint32, np.float32, np.float64])
 def test_sample_sort_more_dtypes(mesh8, dtype):
     rng = np.random.default_rng(41)
