@@ -16,13 +16,42 @@ import numpy as np
 
 
 def read_ints_file(path: str | os.PathLike, dtype=np.int32) -> np.ndarray:
-    """Read an ASCII one-int-per-line file (reference input.txt format)."""
+    """Read an ASCII one-int-per-line file (reference input.txt format).
+
+    Hot path is the native C++ parser (`runtime/native/textio.cpp` — the
+    equivalent of the reference's C fscanf ingest, ``server.c:171-182``, at
+    memory bandwidth); falls back to ``np.loadtxt`` when the native library
+    is unavailable or the file needs its more lenient grammar ('#' comments,
+    '+'-signed ints).
+    """
+    from dsort_tpu.runtime import native
+
+    dtype = np.dtype(dtype)
+    if native.available() and native.supports_text_dtype(dtype):
+        with open(path, "rb") as f:
+            raw = f.read()
+        try:
+            return native.parse_ints_text(raw, dtype)
+        except ValueError:
+            pass  # e.g. '#' comments or '+42' — loadtxt grammar handles them
     return np.loadtxt(path, dtype=dtype, ndmin=1)
 
 
 def write_ints_file(path: str | os.PathLike, data: np.ndarray) -> None:
-    """Write one int per line (byte-compatible with reference output.txt)."""
-    np.savetxt(path, np.asarray(data).reshape(-1), fmt="%d")
+    """Write one int per line (byte-compatible with reference output.txt).
+
+    Native C++ formatting (`textio.cpp`, std::to_chars) when available;
+    ``np.savetxt`` fallback.
+    """
+    from dsort_tpu.runtime import native
+
+    data = np.asarray(data).reshape(-1)
+    if native.available() and native.supports_text_dtype(data.dtype):
+        payload = native.format_ints_text(data)
+        with open(path, "wb") as f:
+            f.write(payload)
+        return
+    np.savetxt(path, data, fmt="%d")
 
 
 def gen_uniform(n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:

@@ -31,80 +31,110 @@ def _load():
             return _lib
         _tried = True
         if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-C", _DIR], capture_output=True, timeout=120, check=True
-                )
-            except Exception:
+            if not _make():
                 return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
-        # K-way merge signatures.
-        for name in ("i32", "i64", "u64", "u32"):
-            fn = getattr(lib, f"dsort_kway_merge_{name}")
-            fn.restype = None
-            fn.argtypes = [
-                ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_int32,
-                ctypes.c_void_p,
-            ]
-        for name in ("u64", "i64"):
-            fn = getattr(lib, f"dsort_kway_merge_kv_{name}")
-            fn.restype = None
-            fn.argtypes = [
-                ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_int32,
-                ctypes.c_int32,
-                ctypes.c_void_p,
-                ctypes.c_void_p,
-            ]
-        lib.dsort_table_create.restype = ctypes.c_void_p
-        lib.dsort_table_create.argtypes = [ctypes.c_int32, ctypes.c_double]
-        lib.dsort_table_destroy.argtypes = [ctypes.c_void_p]
-        lib.dsort_table_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
-        lib.dsort_table_is_alive.restype = ctypes.c_int32
-        lib.dsort_table_is_alive.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.dsort_table_mark_dead.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.dsort_table_first_live.restype = ctypes.c_int32
-        lib.dsort_table_first_live.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.dsort_table_check_heartbeats.restype = ctypes.c_int32
-        lib.dsort_table_check_heartbeats.argtypes = [
-            ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.dsort_table_revive_all.argtypes = [ctypes.c_void_p, ctypes.c_double]
-        lib.dsort_table_death_count.restype = ctypes.c_int32
-        lib.dsort_table_death_count.argtypes = [ctypes.c_void_p]
-        lib.dsort_table_live_count.restype = ctypes.c_int32
-        lib.dsort_table_live_count.argtypes = [ctypes.c_void_p]
-        # Coordinator.
-        lib.dsort_coord_create.restype = ctypes.c_void_p
-        lib.dsort_coord_create.argtypes = [ctypes.c_uint16, ctypes.c_double]
-        lib.dsort_coord_port.restype = ctypes.c_int32
-        lib.dsort_coord_port.argtypes = [ctypes.c_void_p]
-        lib.dsort_coord_wait_workers.restype = ctypes.c_int32
-        lib.dsort_coord_wait_workers.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
-        lib.dsort_coord_num_live.restype = ctypes.c_int32
-        lib.dsort_coord_num_live.argtypes = [ctypes.c_void_p]
-        lib.dsort_coord_submit.restype = ctypes.c_int32
-        lib.dsort_coord_submit.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
-        ]
-        lib.dsort_coord_collect.restype = ctypes.c_int64
-        lib.dsort_coord_collect.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double,
-        ]
-        lib.dsort_coord_kill_worker.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        lib.dsort_coord_reassignments.restype = ctypes.c_int32
-        lib.dsort_coord_reassignments.argtypes = [ctypes.c_void_p]
-        lib.dsort_coord_shutdown.argtypes = [ctypes.c_void_p]
-        lib.dsort_coord_destroy.argtypes = [ctypes.c_void_p]
-        _lib = lib
+            _lib = _open_and_bind()
+        except (OSError, AttributeError):
+            # Missing .so symbols mean a stale prebuilt library from an older
+            # source tree — rebuild once and retry before giving up.
+            try:
+                if _make():
+                    _lib = _open_and_bind()
+            except (OSError, AttributeError):
+                _lib = None
         return _lib
+
+
+def _make() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-B", "-C", _DIR], capture_output=True, timeout=120, check=True
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _open_and_bind():
+    lib = ctypes.CDLL(_LIB_PATH)
+    # K-way merge signatures.
+    for name in ("i32", "i64", "u64", "u32"):
+        fn = getattr(lib, f"dsort_kway_merge_{name}")
+        fn.restype = None
+        fn.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.c_void_p,
+        ]
+    for name in ("u64", "i64"):
+        fn = getattr(lib, f"dsort_kway_merge_kv_{name}")
+        fn.restype = None
+        fn.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+    lib.dsort_table_create.restype = ctypes.c_void_p
+    lib.dsort_table_create.argtypes = [ctypes.c_int32, ctypes.c_double]
+    lib.dsort_table_destroy.argtypes = [ctypes.c_void_p]
+    lib.dsort_table_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
+    lib.dsort_table_is_alive.restype = ctypes.c_int32
+    lib.dsort_table_is_alive.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.dsort_table_mark_dead.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.dsort_table_first_live.restype = ctypes.c_int32
+    lib.dsort_table_first_live.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.dsort_table_check_heartbeats.restype = ctypes.c_int32
+    lib.dsort_table_check_heartbeats.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.dsort_table_revive_all.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.dsort_table_death_count.restype = ctypes.c_int32
+    lib.dsort_table_death_count.argtypes = [ctypes.c_void_p]
+    lib.dsort_table_live_count.restype = ctypes.c_int32
+    lib.dsort_table_live_count.argtypes = [ctypes.c_void_p]
+    # Coordinator.
+    lib.dsort_coord_create.restype = ctypes.c_void_p
+    lib.dsort_coord_create.argtypes = [ctypes.c_uint16, ctypes.c_double]
+    lib.dsort_coord_port.restype = ctypes.c_int32
+    lib.dsort_coord_port.argtypes = [ctypes.c_void_p]
+    lib.dsort_coord_wait_workers.restype = ctypes.c_int32
+    lib.dsort_coord_wait_workers.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
+    lib.dsort_coord_num_live.restype = ctypes.c_int32
+    lib.dsort_coord_num_live.argtypes = [ctypes.c_void_p]
+    lib.dsort_coord_submit.restype = ctypes.c_int32
+    lib.dsort_coord_submit.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
+    ]
+    lib.dsort_coord_collect.restype = ctypes.c_int64
+    lib.dsort_coord_collect.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double,
+    ]
+    lib.dsort_coord_kill_worker.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.dsort_coord_reassignments.restype = ctypes.c_int32
+    lib.dsort_coord_reassignments.argtypes = [ctypes.c_void_p]
+    lib.dsort_coord_shutdown.argtypes = [ctypes.c_void_p]
+    lib.dsort_coord_destroy.argtypes = [ctypes.c_void_p]
+    # ASCII int ingest/egress (textio.cpp).
+    lib.dsort_count_ints.restype = ctypes.c_int64
+    lib.dsort_count_ints.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    for name in ("i32", "i64", "u32", "u64"):
+        fn = getattr(lib, f"dsort_parse_{name}")
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        fn = getattr(lib, f"dsort_format_{name}")
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ]
+    return lib
 
 
 def available() -> bool:
@@ -182,6 +212,65 @@ def kway_merge_kv(
     fn(kptrs, vptrs, lens, len(key_runs), pbytes,
        out_k.ctypes.data_as(ctypes.c_void_p), out_v.ctypes.data_as(ctypes.c_void_p))
     return out_k, out_v
+
+
+_TEXT_SUFFIX = {
+    np.dtype(np.int32): "i32",
+    np.dtype(np.int64): "i64",
+    np.dtype(np.uint32): "u32",
+    np.dtype(np.uint64): "u64",
+}
+# Worst-case formatted width (digits + sign) + newline per element.
+_TEXT_WIDTH = {"i32": 12, "i64": 21, "u32": 11, "u64": 21}
+
+
+def supports_text_dtype(dtype) -> bool:
+    return np.dtype(dtype) in _TEXT_SUFFIX
+
+
+def parse_ints_text(data: bytes, dtype) -> np.ndarray:
+    """Parse whitespace-separated ASCII integers natively.
+
+    Capacity comes from the newline count (exact for the reference's
+    one-int-per-line format, a single memchr-speed scan); only if tokens are
+    packed denser than lines does the parser report capacity overflow and a
+    native token-count pass (the reference's count/rewind/fill ingest shape,
+    ``server.c:171-182``) sizes the retry exactly.  Raises ValueError on
+    malformed tokens or range overflow.
+    """
+    lib = _load()
+    dtype = np.dtype(dtype)
+    fn = getattr(lib, f"dsort_parse_{_TEXT_SUFFIX[dtype]}")
+    cap = data.count(b"\n") + 1
+    out = np.empty(cap, dtype=dtype)
+    n = fn(data, len(data), out.ctypes.data_as(ctypes.c_void_p), cap)
+    if n == -3:  # PARSE_OVERFLOW_CAP: space-separated tokens; count exactly
+        cap = lib.dsort_count_ints(data, len(data))
+        if cap < 0:
+            raise ValueError(f"malformed integer text (native error {cap})")
+        out = np.empty(cap, dtype=dtype)
+        n = fn(data, len(data), out.ctypes.data_as(ctypes.c_void_p), cap)
+    if n == -2:
+        raise ValueError(f"integer text does not fit dtype {dtype}")
+    if n < 0:
+        raise ValueError(f"malformed integer text (native error {n})")
+    # Copy the trim: a view would pin the full cap-sized allocation alive
+    # (blank-line-heavy files overestimate cap badly).
+    return out[:n].copy() if n != len(out) else out
+
+
+def format_ints_text(data: np.ndarray) -> bytes:
+    """Format a 1-D int array as one-int-per-line ASCII, natively."""
+    lib = _load()
+    data = np.ascontiguousarray(data)
+    suffix = _TEXT_SUFFIX[data.dtype]
+    cap = len(data) * _TEXT_WIDTH[suffix] + 1
+    buf = ctypes.create_string_buffer(cap)
+    fn = getattr(lib, f"dsort_format_{suffix}")
+    written = fn(data.ctypes.data_as(ctypes.c_void_p), len(data), buf, cap)
+    if written < 0:
+        raise ValueError("native int formatting failed (buffer overflow)")
+    return buf.raw[:written]
 
 
 class NativeWorkerTable:

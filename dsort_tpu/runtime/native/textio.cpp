@@ -1,0 +1,121 @@
+// textio — native ASCII integer ingest/egress for dsort_tpu.
+//
+// Native parity with the reference's file IO (SURVEY.md §2.1): the reference
+// ingests with a two-pass fscanf loop (count, rewind, fill — server.c:171-182)
+// and egresses one fprintf per int (server.c:517-519), all in C.  These are
+// the framework's equivalents, operating on whole memory buffers so Python
+// does one read()/write() syscall per file and the hot loops are native:
+//
+//  - dsort_count_ints: pass 1 — token count for exact output allocation;
+//  - dsort_parse_{i32,i64,u32,u64}: pass 2 — std::from_chars per token;
+//  - dsort_format_{i32,i64,u32,u64}: std::to_chars, one int per line
+//    (byte-compatible with the reference's output.txt format).
+//
+// Tokens are separated by arbitrary ASCII whitespace; '+'/'-' signs follow
+// std::from_chars semantics (leading '-' only; '+' is rejected like numpy's
+// loadtxt int path would parse it — see PARSE_BAD_CHAR below).  All errors
+// are returned as negative codes (no exceptions across the C ABI).
+
+#include <cctype>
+#include <charconv>
+#include <cstdint>
+
+namespace {
+
+constexpr int64_t PARSE_BAD_CHAR = -1;   // token is not a valid integer
+constexpr int64_t PARSE_RANGE = -2;      // token out of dtype range
+constexpr int64_t PARSE_OVERFLOW_CAP = -3;  // more tokens than `cap`
+
+inline bool is_space(char c) {
+  return c == ' ' || c == '\n' || c == '\t' || c == '\r' || c == '\v' ||
+         c == '\f';
+}
+
+template <typename T>
+int64_t parse_ints(const char* buf, int64_t len, T* out, int64_t cap) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t n = 0;
+  while (true) {
+    while (p < end && is_space(*p)) ++p;
+    if (p >= end) return n;
+    if (n >= cap) return PARSE_OVERFLOW_CAP;
+    T value;
+    auto res = std::from_chars(p, end, value);
+    if (res.ec == std::errc::result_out_of_range) return PARSE_RANGE;
+    if (res.ec != std::errc() || (res.ptr < end && !is_space(*res.ptr)))
+      return PARSE_BAD_CHAR;
+    out[n++] = value;
+    p = res.ptr;
+  }
+}
+
+template <typename T>
+int64_t format_ints(const T* data, int64_t n, char* out, int64_t cap) {
+  char* p = out;
+  char* end = out + cap;
+  for (int64_t i = 0; i < n; ++i) {
+    auto res = std::to_chars(p, end, data[i]);
+    if (res.ec != std::errc() || res.ptr >= end) return -1;
+    p = res.ptr;
+    *p++ = '\n';
+  }
+  return p - out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count integer tokens in `buf`; returns a negative PARSE_* code on a
+// malformed token so the caller can fall back before allocating output.
+int64_t dsort_count_ints(const char* buf, int64_t len) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t n = 0;
+  while (true) {
+    while (p < end && is_space(*p)) ++p;
+    if (p >= end) return n;
+    int64_t value;  // widest signed probe; range is re-checked per dtype later
+    auto res = std::from_chars(p, end, value);
+    if (res.ec == std::errc::result_out_of_range) {
+      // Could still be a valid uint64 above INT64_MAX; probe unsigned too.
+      uint64_t uvalue;
+      res = std::from_chars(p, end, uvalue);
+      if (res.ec != std::errc()) return PARSE_RANGE;
+    } else if (res.ec != std::errc()) {
+      return PARSE_BAD_CHAR;
+    }
+    if (res.ptr < end && !is_space(*res.ptr)) return PARSE_BAD_CHAR;
+    ++n;
+    p = res.ptr;
+  }
+}
+
+int64_t dsort_parse_i32(const char* buf, int64_t len, int32_t* out, int64_t cap) {
+  return parse_ints<int32_t>(buf, len, out, cap);
+}
+int64_t dsort_parse_i64(const char* buf, int64_t len, int64_t* out, int64_t cap) {
+  return parse_ints<int64_t>(buf, len, out, cap);
+}
+int64_t dsort_parse_u32(const char* buf, int64_t len, uint32_t* out, int64_t cap) {
+  return parse_ints<uint32_t>(buf, len, out, cap);
+}
+int64_t dsort_parse_u64(const char* buf, int64_t len, uint64_t* out, int64_t cap) {
+  return parse_ints<uint64_t>(buf, len, out, cap);
+}
+
+int64_t dsort_format_i32(const int32_t* data, int64_t n, char* out, int64_t cap) {
+  return format_ints<int32_t>(data, n, out, cap);
+}
+int64_t dsort_format_i64(const int64_t* data, int64_t n, char* out, int64_t cap) {
+  return format_ints<int64_t>(data, n, out, cap);
+}
+int64_t dsort_format_u32(const uint32_t* data, int64_t n, char* out, int64_t cap) {
+  return format_ints<uint32_t>(data, n, out, cap);
+}
+int64_t dsort_format_u64(const uint64_t* data, int64_t n, char* out, int64_t cap) {
+  return format_ints<uint64_t>(data, n, out, cap);
+}
+
+}  // extern "C"
