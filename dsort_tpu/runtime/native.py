@@ -134,6 +134,18 @@ def _open_and_bind():
         fn.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
         ]
+        fn = getattr(lib, f"dsort_parse_mt_{name}")
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+        ]
+        fn = getattr(lib, f"dsort_format_mt_{name}")
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
     return lib
 
 
@@ -240,37 +252,60 @@ def parse_ints_text(data: bytes, dtype) -> np.ndarray:
     """
     lib = _load()
     dtype = np.dtype(dtype)
-    fn = getattr(lib, f"dsort_parse_{_TEXT_SUFFIX[dtype]}")
+    threads = _text_threads()
+    fn = getattr(lib, f"dsort_parse_mt_{_TEXT_SUFFIX[dtype]}")
+    needed = ctypes.c_int64(-1)
     cap = data.count(b"\n") + 1
     out = np.empty(cap, dtype=dtype)
-    n = fn(data, len(data), out.ctypes.data_as(ctypes.c_void_p), cap)
-    if n == -3:  # PARSE_OVERFLOW_CAP: space-separated tokens; count exactly
-        cap = lib.dsort_count_ints(data, len(data))
+    n = fn(
+        data, len(data), out.ctypes.data_as(ctypes.c_void_p), cap, threads,
+        ctypes.byref(needed),
+    )
+    if n == -3:  # PARSE_OVERFLOW_CAP: tokens denser than lines; size exactly
+        cap = needed.value if needed.value >= 0 else lib.dsort_count_ints(
+            data, len(data)
+        )
         if cap < 0:
             raise ValueError(f"malformed integer text (native error {cap})")
         out = np.empty(cap, dtype=dtype)
-        n = fn(data, len(data), out.ctypes.data_as(ctypes.c_void_p), cap)
+        n = fn(
+            data, len(data), out.ctypes.data_as(ctypes.c_void_p), cap, threads,
+            ctypes.byref(needed),
+        )
     if n == -2:
         raise ValueError(f"integer text does not fit dtype {dtype}")
     if n < 0:
         raise ValueError(f"malformed integer text (native error {n})")
-    # Copy the trim: a view would pin the full cap-sized allocation alive
-    # (blank-line-heavy files overestimate cap badly).
-    return out[:n].copy() if n != len(out) else out
+    if n == len(out):
+        return out
+    if len(out) - n <= 1:  # the usual trailing-newline slack: keep the view
+        return out[:n]
+    # Bigger slack (blank-line-heavy files): copy so the trimmed result does
+    # not pin the oversized allocation alive.
+    return out[:n].copy()
+
+
+def _text_threads() -> int:
+    return min(8, os.cpu_count() or 1)
 
 
 def format_ints_text(data: np.ndarray) -> bytes:
-    """Format a 1-D int array as one-int-per-line ASCII, natively."""
+    """Format a 1-D int array as one-int-per-line ASCII, natively (parallel
+    for large arrays: ranges format at worst-case stride, then compact)."""
     lib = _load()
     data = np.ascontiguousarray(data)
     suffix = _TEXT_SUFFIX[data.dtype]
-    cap = len(data) * _TEXT_WIDTH[suffix] + 1
+    width = _TEXT_WIDTH[suffix]
+    cap = len(data) * width + 1
     buf = ctypes.create_string_buffer(cap)
-    fn = getattr(lib, f"dsort_format_{suffix}")
-    written = fn(data.ctypes.data_as(ctypes.c_void_p), len(data), buf, cap)
+    fn = getattr(lib, f"dsort_format_mt_{suffix}")
+    written = fn(
+        data.ctypes.data_as(ctypes.c_void_p), len(data), buf, cap, width,
+        _text_threads(),
+    )
     if written < 0:
         raise ValueError("native int formatting failed (buffer overflow)")
-    return buf.raw[:written]
+    return ctypes.string_at(buf, written)
 
 
 class NativeWorkerTable:

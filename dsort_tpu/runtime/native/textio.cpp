@@ -19,6 +19,9 @@
 #include <cctype>
 #include <charconv>
 #include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -31,23 +34,41 @@ inline bool is_space(char c) {
          c == '\f';
 }
 
-template <typename T>
-int64_t parse_ints(const char* buf, int64_t len, T* out, int64_t cap) {
+// The one tokenizer all int-parsing paths share (serial parse, MT count, MT
+// parse), so the grammar can never diverge between passes.  ``f(value, n)``
+// returns 0 to continue or a negative PARSE_* code to abort.
+template <typename T, typename F>
+int64_t for_each_int(const char* buf, int64_t len, F&& f) {
   const char* p = buf;
   const char* end = buf + len;
   int64_t n = 0;
   while (true) {
     while (p < end && is_space(*p)) ++p;
     if (p >= end) return n;
-    if (n >= cap) return PARSE_OVERFLOW_CAP;
     T value;
     auto res = std::from_chars(p, end, value);
     if (res.ec == std::errc::result_out_of_range) return PARSE_RANGE;
     if (res.ec != std::errc() || (res.ptr < end && !is_space(*res.ptr)))
       return PARSE_BAD_CHAR;
-    out[n++] = value;
+    int64_t rc = f(value, n);
+    if (rc < 0) return rc;
+    ++n;
     p = res.ptr;
   }
+}
+
+template <typename T>
+int64_t parse_ints(const char* buf, int64_t len, T* out, int64_t cap) {
+  return for_each_int<T>(buf, len, [&](T value, int64_t n) -> int64_t {
+    if (n >= cap) return PARSE_OVERFLOW_CAP;
+    out[n] = value;
+    return 0;
+  });
+}
+
+template <typename T>
+int64_t count_tokens(const char* buf, int64_t len) {
+  return for_each_int<T>(buf, len, [](T, int64_t) -> int64_t { return 0; });
 }
 
 template <typename T>
@@ -61,6 +82,114 @@ int64_t format_ints(const T* data, int64_t n, char* out, int64_t cap) {
     *p++ = '\n';
   }
   return p - out;
+}
+
+// Split [0, len) into at most `nthreads` ranges whose boundaries fall on
+// whitespace, so no token straddles two ranges.  Returns the range ends.
+std::vector<int64_t> split_at_whitespace(const char* buf, int64_t len,
+                                         int32_t nthreads) {
+  std::vector<int64_t> ends;
+  int64_t step = len / nthreads;
+  int64_t prev = 0;
+  for (int32_t t = 0; t + 1 < nthreads; ++t) {
+    int64_t cut = prev + step;
+    if (cut >= len) break;
+    while (cut < len && !is_space(buf[cut])) ++cut;  // finish current token
+    if (cut > prev) ends.push_back(cut);
+    prev = cut;
+  }
+  ends.push_back(len);
+  return ends;
+}
+
+// Parallel parse: a count pass sizes each range's output offset, then every
+// range parses directly into its slice of `out`.  Both passes fan out over
+// `nthreads` std::threads; any per-range error code wins (first range order).
+// On PARSE_OVERFLOW_CAP, `*needed` (if non-null) receives the exact token
+// count so the caller can allocate once and retry without re-counting.
+template <typename T>
+int64_t parse_ints_mt(const char* buf, int64_t len, T* out, int64_t cap,
+                      int32_t nthreads, int64_t* needed) {
+  if (nthreads <= 1 || len < (1 << 20)) return parse_ints<T>(buf, len, out, cap);
+  std::vector<int64_t> ends = split_at_whitespace(buf, len, nthreads);
+  int32_t nr = ends.size();
+  std::vector<int64_t> counts(nr, 0);
+  {
+    std::vector<std::thread> ths;
+    int64_t start = 0;
+    for (int32_t t = 0; t < nr; ++t) {
+      int64_t s = start, e = ends[t];
+      start = e;
+      ths.emplace_back([&, t, s, e] { counts[t] = count_tokens<T>(buf + s, e - s); });
+    }
+    for (auto& th : ths) th.join();
+  }
+  int64_t total = 0;
+  for (int32_t t = 0; t < nr; ++t) {
+    if (counts[t] < 0) return counts[t];
+    total += counts[t];
+  }
+  if (total > cap) {
+    if (needed) *needed = total;
+    return PARSE_OVERFLOW_CAP;
+  }
+  std::vector<int64_t> results(nr, 0);
+  {
+    std::vector<std::thread> ths;
+    int64_t start = 0, off = 0;
+    for (int32_t t = 0; t < nr; ++t) {
+      int64_t s = start, e = ends[t], o = off;
+      start = e;
+      off += counts[t];
+      ths.emplace_back([&, t, s, e, o] {
+        results[t] = parse_ints<T>(buf + s, e - s, out + o, counts[t]);
+      });
+    }
+    for (auto& th : ths) th.join();
+  }
+  for (int32_t t = 0; t < nr; ++t) {
+    if (results[t] < 0) return results[t];
+  }
+  return total;
+}
+
+// Parallel format: each range formats into out at a precomputed worst-case
+// offset stride, then ranges are compacted left with memmove (cheap vs the
+// to_chars work).  Returns total bytes or -1 if `cap` is too small.
+template <typename T>
+int64_t format_ints_mt(const T* data, int64_t n, char* out, int64_t cap,
+                       int32_t max_width, int32_t nthreads) {
+  if (nthreads <= 1 || n < (1 << 18)) return format_ints<T>(data, n, out, cap);
+  if (cap < n * (int64_t)max_width + 1) return -1;
+  int32_t nr = nthreads;
+  int64_t per = (n + nr - 1) / nr;
+  std::vector<int64_t> sizes(nr, 0);
+  {
+    std::vector<std::thread> ths;
+    for (int32_t t = 0; t < nr; ++t) {
+      int64_t s = t * per, e = std::min<int64_t>(n, s + per);
+      if (s >= e) break;
+      // A range's slot is exactly (e-s)*max_width bytes: if a caller ever
+      // understates max_width, the range reports -1 instead of silently
+      // writing the first byte of its neighbor's slot (a data race).  The
+      // final range gets the global +1 slack byte of `cap`.
+      int64_t slot = (e - s) * (int64_t)max_width;
+      if (e == n) slot = cap - s * (int64_t)max_width;
+      ths.emplace_back([&, t, s, e, slot] {
+        sizes[t] = format_ints<T>(data + s, e - s, out + s * max_width, slot);
+      });
+    }
+    for (auto& th : ths) th.join();
+  }
+  int64_t total = 0;
+  for (int32_t t = 0; t < nr; ++t) {
+    if (sizes[t] < 0) return -1;
+    if (sizes[t] == 0) continue;
+    int64_t src = t * per * max_width;
+    if (src != total) std::memmove(out + total, out + src, sizes[t]);
+    total += sizes[t];
+  }
+  return total;
 }
 
 }  // namespace
@@ -116,6 +245,42 @@ int64_t dsort_format_u32(const uint32_t* data, int64_t n, char* out, int64_t cap
 }
 int64_t dsort_format_u64(const uint64_t* data, int64_t n, char* out, int64_t cap) {
   return format_ints<uint64_t>(data, n, out, cap);
+}
+
+// Multi-threaded variants (small inputs fall through to the serial paths).
+// `needed` (nullable) receives the exact token count on PARSE_OVERFLOW_CAP.
+int64_t dsort_parse_mt_i32(const char* buf, int64_t len, int32_t* out,
+                           int64_t cap, int32_t nthreads, int64_t* needed) {
+  return parse_ints_mt<int32_t>(buf, len, out, cap, nthreads, needed);
+}
+int64_t dsort_parse_mt_i64(const char* buf, int64_t len, int64_t* out,
+                           int64_t cap, int32_t nthreads, int64_t* needed) {
+  return parse_ints_mt<int64_t>(buf, len, out, cap, nthreads, needed);
+}
+int64_t dsort_parse_mt_u32(const char* buf, int64_t len, uint32_t* out,
+                           int64_t cap, int32_t nthreads, int64_t* needed) {
+  return parse_ints_mt<uint32_t>(buf, len, out, cap, nthreads, needed);
+}
+int64_t dsort_parse_mt_u64(const char* buf, int64_t len, uint64_t* out,
+                           int64_t cap, int32_t nthreads, int64_t* needed) {
+  return parse_ints_mt<uint64_t>(buf, len, out, cap, nthreads, needed);
+}
+
+int64_t dsort_format_mt_i32(const int32_t* data, int64_t n, char* out,
+                            int64_t cap, int32_t max_width, int32_t nthreads) {
+  return format_ints_mt<int32_t>(data, n, out, cap, max_width, nthreads);
+}
+int64_t dsort_format_mt_i64(const int64_t* data, int64_t n, char* out,
+                            int64_t cap, int32_t max_width, int32_t nthreads) {
+  return format_ints_mt<int64_t>(data, n, out, cap, max_width, nthreads);
+}
+int64_t dsort_format_mt_u32(const uint32_t* data, int64_t n, char* out,
+                            int64_t cap, int32_t max_width, int32_t nthreads) {
+  return format_ints_mt<uint32_t>(data, n, out, cap, max_width, nthreads);
+}
+int64_t dsort_format_mt_u64(const uint64_t* data, int64_t n, char* out,
+                            int64_t cap, int32_t max_width, int32_t nthreads) {
+  return format_ints_mt<uint64_t>(data, n, out, cap, max_width, nthreads);
 }
 
 }  // extern "C"
