@@ -228,6 +228,40 @@ def cmd_external(args) -> int:
     return 0
 
 
+def cmd_validate(args) -> int:
+    """Validate a sort output (valsort role): order + permutation-of-input."""
+    from dsort_tpu.models.validate import (
+        checksum_ints_file,
+        checksum_terasort_file,
+        validate_ints_file,
+        validate_terasort_file,
+    )
+
+    if args.terasort:
+        rep = validate_terasort_file(args.input)
+    else:
+        rep = validate_ints_file(args.input, dtype=np.dtype(args.dtype))
+    result = {
+        "records": rep.records,
+        "sorted": rep.sorted_ok,
+        "checksum": f"{rep.checksum:016x}",
+    }
+    if rep.first_violation is not None:
+        result["first_violation"] = rep.first_violation
+    ok = rep.sorted_ok
+    if args.against:
+        if args.terasort:
+            n_in, sum_in = checksum_terasort_file(args.against)
+        else:
+            n_in, sum_in = checksum_ints_file(args.against, dtype=np.dtype(args.dtype))
+        result["permutation_of_input"] = (
+            n_in == rep.records and sum_in == rep.checksum
+        )
+        ok = ok and result["permutation_of_input"]
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def cmd_coordinator(args) -> int:
     """Run the native coordinator and serve REPL jobs over the cluster."""
     from dsort_tpu.runtime import NativeCoordinator
@@ -320,6 +354,16 @@ def main(argv=None) -> int:
     p.add_argument("--no-resume", action="store_true",
                    help="discard checkpointed runs and start fresh")
     p.set_defaults(fn=cmd_external)
+
+    p = sub.add_parser(
+        "validate", help="validate a sort output (order + permutation checksum)"
+    )
+    p.add_argument("input")
+    p.add_argument("--against", help="original input file to prove permutation")
+    p.add_argument("--terasort", action="store_true",
+                   help="treat files as binary 100-byte-record TeraSort data")
+    p.add_argument("--dtype", default="int32")
+    p.set_defaults(fn=cmd_validate)
 
     p = sub.add_parser("coordinator", help="native TCP coordinator + job REPL")
     common(p)  # provides --workers (cluster size; default 4 below)

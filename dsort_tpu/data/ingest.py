@@ -34,6 +34,8 @@ def read_ints_file(path: str | os.PathLike, dtype=np.int32) -> np.ndarray:
             return native.parse_ints_text(raw, dtype)
         except ValueError:
             pass  # e.g. '#' comments or '+42' — loadtxt grammar handles them
+        # OverflowError propagates: values outside `dtype` must fail loudly,
+        # not fall back to np.loadtxt, which wraps them to INT_MIN silently.
     return np.loadtxt(path, dtype=dtype, ndmin=1)
 
 

@@ -1,0 +1,152 @@
+"""Sort-output validation — the valsort role of the TeraSort tool suite.
+
+The reference's only validation artifact is its golden ``input.txt`` /
+``output.txt`` pair (``output.txt`` equals ``sort -n input.txt``; SURVEY.md
+§4).  This module generalizes that into a tool a user can run on any job:
+
+- **order**: the output's keys are nondecreasing (TeraSort records compare
+  as big-endian byte strings over the 10-byte key);
+- **permutation**: an order-independent multiset checksum (sum mod 2^64 of
+  per-record FNV-1a, `runtime/native/textio.cpp`) over input and output
+  proves the output is exactly a permutation of the input — no records
+  dropped, duplicated, or corrupted.
+
+Binary TeraSort files stream in bounded chunks (order checks compare each
+chunk's first key against the previous chunk's last), so that path is
+out-of-core like `models.external_sort`.  ASCII int files go through the
+native text parser and are validated in memory — bounded by the same ingest
+cost the sort itself pays.
+"""
+
+from __future__ import annotations
+
+import os
+from collections.abc import Iterator
+from dataclasses import dataclass
+
+import numpy as np
+
+from dsort_tpu.data.ingest import RECORD_BYTES, read_ints_file
+from dsort_tpu.runtime import native
+from dsort_tpu.utils.logging import get_logger
+
+log = get_logger("validate")
+
+_CHUNK_RECORDS = 1 << 20  # ~100 MB of TeraSort records per streamed chunk
+_MASK64 = (1 << 64) - 1
+
+
+@dataclass
+class ValidationReport:
+    """Outcome of one validation run."""
+
+    records: int
+    sorted_ok: bool
+    first_violation: int | None  # record index of the first order break
+    checksum: int  # multiset checksum (mod 2^64)
+
+    @property
+    def ok(self) -> bool:
+        return self.sorted_ok
+
+
+def _fnv_multiset_py(buf: np.ndarray, nrec: int, rec_bytes: int) -> int:
+    """Vectorized numpy fallback of the native FNV multiset sum."""
+    if nrec == 0:
+        return 0
+    flat = np.ascontiguousarray(buf).view(np.uint8).reshape(-1)
+    rows = flat[: nrec * rec_bytes].reshape(nrec, rec_bytes).astype(np.uint64)
+    with np.errstate(over="ignore"):
+        h = np.full(nrec, np.uint64(1469598103934665603))
+        prime = np.uint64(1099511628211)
+        for b in range(rec_bytes):  # byte-column sweep: nrec-wide u64 ops
+            h = (h ^ rows[:, b]) * prime
+        total = int(np.sum(h, dtype=np.uint64))
+    return total & _MASK64
+
+
+def _multiset(buf: np.ndarray, nrec: int, rec_bytes: int) -> int:
+    if native.available():
+        return native.fnv_multiset(buf, nrec, rec_bytes)
+    return _fnv_multiset_py(buf, nrec, rec_bytes)
+
+
+def _check_order_chunk(chunk: np.ndarray, nrec: int) -> int:
+    """First in-chunk record whose 10-byte key dips below its predecessor's
+    (1-based), or -1."""
+    if native.available():
+        return native.check_order_be(chunk, nrec, RECORD_BYTES, 10)
+    rows = chunk.reshape(nrec, RECORD_BYTES)[:, :10]
+    keys = [bytes(r) for r in rows]
+    return next((i for i in range(1, nrec) if keys[i] < keys[i - 1]), -1)
+
+
+def _iter_record_chunks(
+    path: str | os.PathLike,
+) -> Iterator[tuple[int, np.ndarray]]:
+    """Yield ``(start_record, chunk_bytes)`` over a binary TeraSort file."""
+    size = os.path.getsize(path)
+    if size % RECORD_BYTES:
+        raise ValueError(f"{path}: size {size} not a multiple of {RECORD_BYTES}")
+    nrec = size // RECORD_BYTES
+    if nrec == 0:
+        return
+    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    for lo in range(0, nrec, _CHUNK_RECORDS):
+        hi = min(lo + _CHUNK_RECORDS, nrec)
+        yield lo, np.array(mm[lo * RECORD_BYTES : hi * RECORD_BYTES])
+
+
+def validate_terasort_file(path: str | os.PathLike) -> ValidationReport:
+    """Validate a binary TeraSort file: full 10-byte-key order + checksum."""
+    nrec = 0
+    checksum = 0
+    sorted_ok = True
+    first_violation: int | None = None
+    prev_key: bytes | None = None
+    for lo, chunk in _iter_record_chunks(path):
+        n = len(chunk) // RECORD_BYTES
+        nrec = lo + n
+        if sorted_ok:
+            # Boundary pair: previous chunk's last key vs this chunk's first.
+            if prev_key is not None and bytes(chunk[:10]) < prev_key:
+                sorted_ok, first_violation = False, lo
+            else:
+                v = _check_order_chunk(chunk, n)
+                if v >= 0:
+                    sorted_ok, first_violation = False, lo + v
+        checksum = (checksum + _multiset(chunk, n, RECORD_BYTES)) & _MASK64
+        prev_key = bytes(chunk[-RECORD_BYTES : -RECORD_BYTES + 10])
+    return ValidationReport(nrec, sorted_ok, first_violation, checksum)
+
+
+def checksum_terasort_file(path: str | os.PathLike) -> tuple[int, int]:
+    """(record count, multiset checksum) of a binary TeraSort file."""
+    nrec = 0
+    checksum = 0
+    for lo, chunk in _iter_record_chunks(path):
+        n = len(chunk) // RECORD_BYTES
+        nrec = lo + n
+        checksum = (checksum + _multiset(chunk, n, RECORD_BYTES)) & _MASK64
+    return nrec, checksum
+
+
+def validate_ints_file(
+    path: str | os.PathLike, dtype=np.int32
+) -> ValidationReport:
+    """Validate an ASCII one-int-per-line file (the reference output format)."""
+    data = read_ints_file(path, dtype=dtype)
+    checksum = _multiset(data, len(data), data.dtype.itemsize)
+    if len(data) < 2:
+        return ValidationReport(len(data), True, None, checksum)
+    diffs_ok = data[1:] >= data[:-1]
+    sorted_ok = bool(diffs_ok.all())
+    first_violation = None if sorted_ok else int(np.argmin(diffs_ok)) + 1
+    return ValidationReport(len(data), sorted_ok, first_violation, checksum)
+
+
+def checksum_ints_file(path: str | os.PathLike, dtype=np.int32) -> tuple[int, int]:
+    """(record count, multiset checksum) of an ASCII int file — compare with
+    the output's report to prove permutation."""
+    data = read_ints_file(path, dtype=dtype)
+    return len(data), _multiset(data, len(data), data.dtype.itemsize)

@@ -146,6 +146,15 @@ def _open_and_bind():
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32,
         ]
+    # Validation primitives (the valsort role).
+    lib.dsort_fnv_multiset.restype = ctypes.c_uint64
+    lib.dsort_fnv_multiset.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.dsort_check_order_be.restype = ctypes.c_int64
+    lib.dsort_check_order_be.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+    ]
     return lib
 
 
@@ -265,6 +274,8 @@ def parse_ints_text(data: bytes, dtype) -> np.ndarray:
         cap = needed.value if needed.value >= 0 else lib.dsort_count_ints(
             data, len(data)
         )
+        if cap == -2:
+            raise OverflowError("integer text does not fit any 64-bit dtype")
         if cap < 0:
             raise ValueError(f"malformed integer text (native error {cap})")
         out = np.empty(cap, dtype=dtype)
@@ -273,7 +284,12 @@ def parse_ints_text(data: bytes, dtype) -> np.ndarray:
             ctypes.byref(needed),
         )
     if n == -2:
-        raise ValueError(f"integer text does not fit dtype {dtype}")
+        # Distinct exception type: callers must NOT recover from this by
+        # falling back to a lossier parser (np.loadtxt wraps out-of-range
+        # values to INT_MIN silently — a sort over corrupted keys).
+        raise OverflowError(
+            f"integer text does not fit dtype {dtype}; use a wider KEY_DTYPE"
+        )
     if n < 0:
         raise ValueError(f"malformed integer text (native error {n})")
     if n == len(out):
@@ -306,6 +322,34 @@ def format_ints_text(data: np.ndarray) -> bytes:
     if written < 0:
         raise ValueError("native int formatting failed (buffer overflow)")
     return ctypes.string_at(buf, written)
+
+
+def fnv_multiset(buf, nrec: int, rec_bytes: int) -> int:
+    """Order-independent multiset checksum: sum mod 2^64 of per-record FNV-1a.
+
+    Equal record multisets give equal sums regardless of order — comparing a
+    sort's input and output proves the output is a permutation of the input
+    (the valsort checksum role).
+    """
+    lib = _load()
+    if isinstance(buf, np.ndarray):
+        buf = np.ascontiguousarray(buf)
+        ptr = buf.ctypes.data_as(ctypes.c_void_p)
+    else:
+        ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p)
+    return int(lib.dsort_fnv_multiset(ptr, nrec, rec_bytes))
+
+
+def check_order_be(buf, nrec: int, rec_bytes: int, key_bytes: int) -> int:
+    """First 1-based index whose big-endian key dips below its predecessor's,
+    or -1 when the chunk is nondecreasing (TeraSort byte-string key order)."""
+    lib = _load()
+    if isinstance(buf, np.ndarray):
+        buf = np.ascontiguousarray(buf)
+        ptr = buf.ctypes.data_as(ctypes.c_void_p)
+    else:
+        ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p)
+    return int(lib.dsort_check_order_be(ptr, nrec, rec_bytes, key_bytes))
 
 
 class NativeWorkerTable:

@@ -284,3 +284,44 @@ int64_t dsort_format_mt_u64(const uint64_t* data, int64_t n, char* out,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Validation primitives (the valsort role of the TeraSort tool suite):
+// a permutation-invariant multiset checksum and a big-endian key order check,
+// both chunk-callable so Python can stream arbitrarily large files.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Sum (mod 2^64) of FNV-1a 64-bit hashes of each rec_bytes-sized record.
+// Addition is commutative, so equal multisets of records give equal sums
+// regardless of order — comparing input and output proves permutation.
+uint64_t dsort_fnv_multiset(const uint8_t* buf, int64_t nrec,
+                            int32_t rec_bytes) {
+  uint64_t sum = 0;
+  for (int64_t i = 0; i < nrec; ++i) {
+    const uint8_t* r = buf + i * rec_bytes;
+    uint64_t h = 1469598103934665603ull;  // FNV offset basis
+    for (int32_t b = 0; b < rec_bytes; ++b) {
+      h ^= r[b];
+      h *= 1099511628211ull;  // FNV prime
+    }
+    sum += h;
+  }
+  return sum;
+}
+
+// First index i (1-based within this chunk) where record i's key compares
+// below record i-1's key as a big-endian byte string (memcmp on the first
+// key_bytes of each record, the TeraSort order), or -1 if nondecreasing.
+int64_t dsort_check_order_be(const uint8_t* buf, int64_t nrec,
+                             int32_t rec_bytes, int32_t key_bytes) {
+  for (int64_t i = 1; i < nrec; ++i) {
+    if (std::memcmp(buf + i * rec_bytes, buf + (i - 1) * rec_bytes,
+                    key_bytes) < 0)
+      return i;
+  }
+  return -1;
+}
+
+}  // extern "C"

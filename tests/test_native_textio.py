@@ -61,22 +61,37 @@ def test_space_separated_denser_than_lines_hits_retry_path():
     )
 
 
-@pytest.mark.parametrize(
-    "bad", [b"12 abc", b"1.5", b"0x10", b"99999999999999999999999999 1"]
-)
+@pytest.mark.parametrize("bad", [b"12 abc", b"1.5", b"0x10"])
 def test_malformed_tokens_raise(bad):
     with pytest.raises(ValueError):
         native.parse_ints_text(bad, np.int32)
 
 
 def test_range_is_per_dtype():
-    with pytest.raises(ValueError):
+    # Out-of-range raises OverflowError specifically — callers must not
+    # recover into a lossy fallback that silently wraps keys.
+    with pytest.raises(OverflowError):
         native.parse_ints_text(b"3000000000", np.int32)
+    with pytest.raises(OverflowError):
+        native.parse_ints_text(b"99999999999999999999999999 1", np.int32)
     assert native.parse_ints_text(b"3000000000", np.uint32)[0] == 3_000_000_000
     big = str(2**64 - 1).encode()
     assert native.parse_ints_text(big, np.uint64)[0] == np.uint64(2**64 - 1)
+    # '-' into unsigned is a grammar reject (from_chars), not a range error
     with pytest.raises(ValueError):
         native.parse_ints_text(b"-1", np.uint32)
+
+
+def test_read_ints_file_overflow_is_loud_not_wrapped(tmp_path):
+    # Regression: an int64-sized key read with the default int32 dtype used
+    # to fall back to np.loadtxt and silently wrap to INT32_MIN, corrupting
+    # the sort. It must raise instead.
+    p = tmp_path / "big.txt"
+    p.write_text("1\n2000734708531680000\n2\n")
+    with pytest.raises(OverflowError):
+        read_ints_file(p, dtype=np.int32)
+    out = read_ints_file(p, dtype=np.int64)
+    assert out.tolist() == [1, 2000734708531680000, 2]
 
 
 def test_read_write_ints_file_native_path(tmp_path):

@@ -1,0 +1,113 @@
+"""Validation subsystem tests (models/validate.py — the valsort role).
+
+The reference's validation story is one golden pair checked by eye (SURVEY.md
+§4); here order + permutation proof must hold for arbitrary jobs, streamed.
+"""
+
+import numpy as np
+import pytest
+
+from dsort_tpu.data.ingest import (
+    gen_terasort_file,
+    write_ints_file,
+)
+from dsort_tpu.models.validate import (
+    _CHUNK_RECORDS,
+    checksum_ints_file,
+    validate_ints_file,
+    validate_terasort_file,
+)
+from tests.test_cli_checkpoint import cli_main  # shared CLI harness import
+
+
+def test_ints_sorted_and_permutation(tmp_path):
+    rng = np.random.default_rng(1)
+    data = rng.integers(-(2**31), 2**31 - 1, 10_000).astype(np.int32)
+    inp, outp = tmp_path / "in.txt", tmp_path / "out.txt"
+    write_ints_file(inp, data)
+    write_ints_file(outp, np.sort(data))
+    rep = validate_ints_file(outp)
+    assert rep.sorted_ok and rep.records == 10_000
+    n_in, sum_in = checksum_ints_file(inp)
+    assert (n_in, sum_in) == (rep.records, rep.checksum)
+
+
+def test_ints_detects_unsorted_and_tamper(tmp_path):
+    data = np.arange(1000, dtype=np.int32)
+    bad = data.copy()
+    bad[500], bad[501] = bad[501], bad[500]
+    p = tmp_path / "bad.txt"
+    write_ints_file(p, bad)
+    rep = validate_ints_file(p)
+    assert not rep.sorted_ok and rep.first_violation == 501
+    # tampering one value changes the multiset checksum
+    q = tmp_path / "tampered.txt"
+    t = np.sort(data)
+    t[7] += 1
+    write_ints_file(q, t)
+    assert validate_ints_file(q).checksum != checksum_ints_file(p)[1]
+
+
+def test_terasort_validate_roundtrip(tmp_path):
+    inp, outp = tmp_path / "t.bin", tmp_path / "t_out.bin"
+    gen_terasort_file(inp, 3_000, seed=2)
+    assert cli_main(["terasort", str(inp), "-o", str(outp), "--workers", "8"]) == 0
+    rep = validate_terasort_file(outp)
+    assert rep.sorted_ok and rep.records == 3_000
+    assert not validate_terasort_file(inp).sorted_ok  # random input isn't sorted
+    # permutation proof input <-> output
+    from dsort_tpu.models.validate import checksum_terasort_file
+
+    assert checksum_terasort_file(inp) == (rep.records, rep.checksum)
+
+
+def test_terasort_boundary_violation_detected(tmp_path, monkeypatch):
+    # Order break exactly at a streamed chunk boundary must be caught.
+    import dsort_tpu.models.validate as v
+
+    monkeypatch.setattr(v, "_CHUNK_RECORDS", 4)
+    recs = np.zeros((8, 100), dtype=np.uint8)
+    for i in range(8):
+        recs[i, 0] = i
+    recs[[3, 4]] = recs[[4, 3]]  # records 3/4 swap: violation at index 4
+    p = tmp_path / "b.bin"
+    recs.tofile(p)
+    rep = v.validate_terasort_file(p)
+    assert not rep.sorted_ok
+    assert rep.first_violation == 4
+
+
+def test_empty_and_single(tmp_path):
+    p = tmp_path / "e.txt"
+    p.write_text("")
+    rep = validate_ints_file(p)
+    assert rep.ok and rep.records == 0
+    p.write_text("42\n")
+    rep = validate_ints_file(p)
+    assert rep.ok and rep.records == 1
+
+
+def test_cli_validate_exit_codes(tmp_path):
+    data = np.arange(100, dtype=np.int32)
+    good, bad, orig = tmp_path / "g.txt", tmp_path / "b.txt", tmp_path / "o.txt"
+    write_ints_file(orig, data[::-1].copy())
+    write_ints_file(good, data)
+    write_ints_file(bad, data[::-1].copy())
+    assert cli_main(["validate", str(good), "--against", str(orig)]) == 0
+    assert cli_main(["validate", str(bad)]) == 1
+    # dropped record -> permutation check fails even though sorted
+    write_ints_file(good, data[:-1])
+    assert cli_main(["validate", str(good), "--against", str(orig)]) == 1
+
+
+def test_python_fnv_fallback_matches_native():
+    from dsort_tpu.models.validate import _fnv_multiset_py
+    from dsort_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(31)
+    buf = rng.integers(0, 256, (500, 100), dtype=np.uint8)
+    assert _fnv_multiset_py(buf, 500, 100) == native.fnv_multiset(buf, 500, 100)
+    ints = rng.integers(-(2**31), 2**31 - 1, 777).astype(np.int32)
+    assert _fnv_multiset_py(ints, 777, 4) == native.fnv_multiset(ints, 777, 4)
