@@ -12,9 +12,16 @@ output is a total ascending order of the input.
   (SURVEY.md §5.7).
 - ``external_sort``: out-of-core runs-on-disk + native streaming merge for
   datasets larger than device/host memory.
+- ``validate``: the valsort role — order + permutation-checksum validation
+  of any job's output against its input.
 """
 
 from dsort_tpu.models.external_sort import ExternalSort  # noqa: F401
+from dsort_tpu.models.validate import (  # noqa: F401
+    ValidationReport,
+    validate_ints_file,
+    validate_terasort_file,
+)
 from dsort_tpu.models.pipelines import (  # noqa: F401
     GatherMergeSort,
     local_pipeline,

@@ -55,12 +55,14 @@ def _fnv_multiset_py(buf: np.ndarray, nrec: int, rec_bytes: int) -> int:
     if nrec == 0:
         return 0
     flat = np.ascontiguousarray(buf).view(np.uint8).reshape(-1)
-    rows = flat[: nrec * rec_bytes].reshape(nrec, rec_bytes).astype(np.uint64)
+    rows = flat[: nrec * rec_bytes].reshape(nrec, rec_bytes)
     with np.errstate(over="ignore"):
         h = np.full(nrec, np.uint64(1469598103934665603))
         prime = np.uint64(1099511628211)
         for b in range(rec_bytes):  # byte-column sweep: nrec-wide u64 ops
-            h = (h ^ rows[:, b]) * prime
+            # Per-column astype keeps the transient at 8*nrec bytes instead
+            # of widening the whole chunk to uint64 (8x blow-up) up front.
+            h = (h ^ rows[:, b].astype(np.uint64)) * prime
         total = int(np.sum(h, dtype=np.uint64))
     return total & _MASK64
 

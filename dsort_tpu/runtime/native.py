@@ -324,6 +324,14 @@ def format_ints_text(data: np.ndarray) -> bytes:
     return ctypes.string_at(buf, written)
 
 
+def _as_ptr(buf):
+    """(void* pointer, keepalive) for an ndarray or bytes-like buffer."""
+    if isinstance(buf, np.ndarray):
+        buf = np.ascontiguousarray(buf)
+        return buf.ctypes.data_as(ctypes.c_void_p), buf
+    return ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p), buf
+
+
 def fnv_multiset(buf, nrec: int, rec_bytes: int) -> int:
     """Order-independent multiset checksum: sum mod 2^64 of per-record FNV-1a.
 
@@ -332,11 +340,7 @@ def fnv_multiset(buf, nrec: int, rec_bytes: int) -> int:
     (the valsort checksum role).
     """
     lib = _load()
-    if isinstance(buf, np.ndarray):
-        buf = np.ascontiguousarray(buf)
-        ptr = buf.ctypes.data_as(ctypes.c_void_p)
-    else:
-        ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p)
+    ptr, keep = _as_ptr(buf)
     return int(lib.dsort_fnv_multiset(ptr, nrec, rec_bytes))
 
 
@@ -344,11 +348,7 @@ def check_order_be(buf, nrec: int, rec_bytes: int, key_bytes: int) -> int:
     """First 1-based index whose big-endian key dips below its predecessor's,
     or -1 when the chunk is nondecreasing (TeraSort byte-string key order)."""
     lib = _load()
-    if isinstance(buf, np.ndarray):
-        buf = np.ascontiguousarray(buf)
-        ptr = buf.ctypes.data_as(ctypes.c_void_p)
-    else:
-        ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p)
+    ptr, keep = _as_ptr(buf)
     return int(lib.dsort_check_order_be(ptr, nrec, rec_bytes, key_bytes))
 
 

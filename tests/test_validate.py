@@ -12,7 +12,6 @@ from dsort_tpu.data.ingest import (
     write_ints_file,
 )
 from dsort_tpu.models.validate import (
-    _CHUNK_RECORDS,
     checksum_ints_file,
     validate_ints_file,
     validate_terasort_file,
