@@ -301,6 +301,14 @@ def cmd_coordinator(args) -> int:
 
 
 def main(argv=None) -> int:
+    # 64-bit keys (int64/uint64 — BASELINE config #3, TeraSort prefixes) need
+    # x64 mode, and it must be set before any backend use.  The library is
+    # tested under x64 (tests/conftest.py), so enable it for every command
+    # rather than crashing only the 64-bit code paths.
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
     ap = argparse.ArgumentParser(prog="dsort", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
 

@@ -64,6 +64,13 @@ class SortWorker:
         if backend == "jax":
             import jax
 
+            if self.dtype.itemsize == 8:
+                # Without x64 mode JAX silently downcasts int64/uint64 inputs
+                # to 32-bit — the sorted result frame would come back
+                # half-length and value-truncated.  This worker is its own
+                # entrypoint (never passes through cli.main), so it must
+                # enable x64 itself.
+                jax.config.update("jax_enable_x64", True)
             self._jit_sort = jax.jit(lambda x: jax.numpy.sort(x))
         else:
             self._jit_sort = None

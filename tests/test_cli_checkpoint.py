@@ -136,3 +136,35 @@ def test_global_worker_mesh():
 
     mesh = global_worker_mesh()
     assert mesh.shape["w"] >= 8
+
+
+def test_cli_subprocess_enables_x64(tmp_path):
+    """64-bit CLI paths must work in a fresh process (no conftest x64).
+
+    Regression: `dsort external --dtype int64` / `dsort terasort` crashed
+    outside the test harness because nothing enabled jax_enable_x64 before
+    configs were built — the CLI must do it itself.
+    """
+    import os
+    import subprocess
+    import sys
+
+    big = tmp_path / "big.bin"
+    out = tmp_path / "big_sorted.bin"
+    data = np.random.default_rng(7).integers(
+        -(2**63), 2**63 - 1, 20_000, dtype=np.int64
+    )
+    data.tofile(big)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env.pop("JAX_ENABLE_X64", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # site hook hangs with cpu pinned
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.run(
+        [sys.executable, "-m", "dsort_tpu.cli", "external", str(big),
+         "-o", str(out), "--dtype", "int64"],
+        check=True, env=env, timeout=300,
+    )
+    np.testing.assert_array_equal(
+        np.fromfile(out, dtype=np.int64), np.sort(data)
+    )

@@ -160,3 +160,44 @@ def test_native_selftest_binary():
     )
     assert out.returncode == 0, out.stderr
     assert "SELFTEST PASS" in out.stdout
+
+
+def test_jax_worker_int64_cluster():
+    """int64 keys through real jax-backend worker subprocesses.
+
+    Regression: SortWorker's own entrypoint never passes through cli.main(),
+    so without enabling x64 itself a jax-backed int64 worker silently
+    downcast keys to int32 and returned half-length, value-truncated result
+    frames.
+    """
+    from dsort_tpu.runtime import NativeCoordinator
+
+    coord = NativeCoordinator(port=0, heartbeat_timeout_s=10.0)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_ENABLE_X64", None)  # the worker must enable x64 itself
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "dsort_tpu.runtime.worker",
+             "--port", str(coord.port), "--backend", "jax", "--dtype", "int64"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        for _ in range(2)
+    ]
+    try:
+        coord.wait_workers(2, timeout_s=60.0)
+        data = np.random.default_rng(9).integers(
+            -(2**63), 2**63 - 1, 10_000, dtype=np.int64
+        )
+        out = coord.run_job(data, num_shards=2)
+        assert out.dtype == np.int64
+        np.testing.assert_array_equal(out, np.sort(data))
+    finally:
+        coord.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
