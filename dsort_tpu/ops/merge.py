@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dsort_tpu.ops.local_sort import sort_keys
+
 
 def merge_sorted_host(chunks: list[np.ndarray]) -> np.ndarray:
     """Heap-based k-way merge of sorted host arrays (O(N log k)).
@@ -71,4 +73,4 @@ def merge_shards_device(shards: jax.Array, counts: jax.Array) -> tuple[jax.Array
     leaves all valid data in the prefix of length ``sum(counts)``.
     """
     flat = shards.reshape(-1)
-    return jnp.sort(flat), jnp.sum(counts).astype(jnp.int32)
+    return sort_keys(flat), jnp.sum(counts).astype(jnp.int32)

@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dsort_tpu.config import JobConfig
 from dsort_tpu.data.partition import pad_kv_to_shards, pad_to_shards
-from dsort_tpu.ops.local_sort import sentinel_for, sort_padded
+from dsort_tpu.ops.local_sort import sentinel_for, sort_keys, sort_padded
 from dsort_tpu.utils.logging import get_logger
 from dsort_tpu.utils.metrics import Metrics, PhaseTimer
 
@@ -51,7 +51,7 @@ def _choose_splitters(xs_sorted, count, num_workers: int, oversample: int, axis:
     idx = ((j + 0.5) * count.astype(jnp.float32) / s).astype(jnp.int32)
     idx = jnp.clip(idx, 0, max(n_local - 1, 0))
     samples = jnp.where(count > 0, xs_sorted[idx], sent)
-    all_samples = jnp.sort(jax.lax.all_gather(samples, axis, tiled=True))
+    all_samples = sort_keys(jax.lax.all_gather(samples, axis, tiled=True))
     return all_samples[s * jnp.arange(1, num_workers)]
 
 
@@ -109,7 +109,7 @@ def _merge_received(recv: jax.Array, merge_kernel: str) -> jax.Array:
         # All valid keys sort ahead of the pads, so trimming to the original
         # total keeps every valid element and matches the "sort" path shape.
         return merge_sorted_runs(recv)[:out_len]
-    return jnp.sort(recv.reshape(-1))
+    return sort_keys(recv.reshape(-1))
 
 
 def _sample_sort_shard(

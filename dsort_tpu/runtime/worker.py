@@ -71,7 +71,9 @@ class SortWorker:
                 # entrypoint (never passes through cli.main), so it must
                 # enable x64 itself.
                 jax.config.update("jax_enable_x64", True)
-            self._jit_sort = jax.jit(lambda x: jax.numpy.sort(x))
+            from dsort_tpu.ops.local_sort import sort_keys
+
+            self._jit_sort = jax.jit(sort_keys)
         else:
             self._jit_sort = None
 
