@@ -51,21 +51,33 @@ def _apply_perm(payload: jax.Array, perm: jax.Array, axis: int) -> jax.Array:
     return jnp.take_along_axis(payload, idx, axis=axis)
 
 
-def sort_kv(keys: jax.Array, payload: jax.Array) -> tuple[jax.Array, jax.Array]:
+def sort_kv(
+    keys: jax.Array, payload: jax.Array, stable: bool = True
+) -> tuple[jax.Array, jax.Array]:
     """Sort ``keys`` ascending, permuting ``payload`` rows along with them.
 
     ``payload`` has shape ``keys.shape + (...,)`` — e.g. TeraSort's 90-byte
     values as ``(n, 90)`` uint8.  Uses ``lax.sort``'s multi-operand form, so
     the permutation is applied on-chip in one fused op.
+
+    ``stable=True`` (default) keeps equal-key payloads in input order, like
+    the reference's stable merge sort (``client.c:140-173``).  Pass
+    ``stable=False`` when any order of equal-key records is acceptable
+    (e.g. TeraSort validity) — the unstable network is ~50% faster
+    (measured 288 vs 190 Mrec/s at 2^22 int64+2 operands single-chip).
     """
     if payload.ndim == keys.ndim:
-        out_k, out_v = jax.lax.sort((keys, payload), dimension=-1, num_keys=1)
+        out_k, out_v = jax.lax.sort(
+            (keys, payload), dimension=-1, num_keys=1, is_stable=stable
+        )
         return out_k, out_v
     # lax.sort wants equal-shaped operands; sort an index permutation instead.
     idx = jnp.broadcast_to(
         jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1), keys.shape
     )
-    out_k, perm = jax.lax.sort((keys, idx), dimension=-1, num_keys=1)
+    out_k, perm = jax.lax.sort(
+        (keys, idx), dimension=-1, num_keys=1, is_stable=stable
+    )
     return out_k, _apply_perm(payload, perm, keys.ndim - 1)
 
 
@@ -115,6 +127,7 @@ def sort_kv2_padded(
     secondary: jax.Array,
     payload: jax.Array,
     count: jax.Array | int,
+    stable: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Two-level key+payload `sort_padded`: order is ``(key, secondary)``.
 
@@ -130,14 +143,20 @@ def sort_kv2_padded(
     masked = jnp.where(pos < count, keys, sentinel_for(keys.dtype))
     if payload.ndim == keys.ndim:
         out_k, _, out_s, out_v = jax.lax.sort(
-            (masked, is_pad, secondary, payload), dimension=-1, num_keys=3
+            (masked, is_pad, secondary, payload),
+            dimension=-1,
+            num_keys=3,
+            is_stable=stable,
         )
         return out_k, out_s, out_v, jnp.asarray(count, jnp.int32)
     idx = jnp.broadcast_to(
         jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1), keys.shape
     )
     out_k, _, out_s, perm = jax.lax.sort(
-        (masked, is_pad, secondary, idx), dimension=-1, num_keys=3
+        (masked, is_pad, secondary, idx),
+        dimension=-1,
+        num_keys=3,
+        is_stable=stable,
     )
     return (
         out_k,
@@ -148,7 +167,10 @@ def sort_kv2_padded(
 
 
 def sort_kv_padded(
-    keys: jax.Array, payload: jax.Array, count: jax.Array | int
+    keys: jax.Array,
+    payload: jax.Array,
+    count: jax.Array | int,
+    stable: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Key+payload variant of `sort_padded`, reserving no key value.
 
@@ -160,11 +182,13 @@ def sort_kv_padded(
     masked = jnp.where(pos < count, keys, sentinel_for(keys.dtype))
     if payload.ndim == keys.ndim:
         out_k, _, out_v = jax.lax.sort(
-            (masked, is_pad, payload), dimension=-1, num_keys=2
+            (masked, is_pad, payload), dimension=-1, num_keys=2, is_stable=stable
         )
         return out_k, out_v, jnp.asarray(count, jnp.int32)
     idx = jnp.broadcast_to(
         jax.lax.broadcasted_iota(jnp.int32, keys.shape, keys.ndim - 1), keys.shape
     )
-    out_k, _, perm = jax.lax.sort((masked, is_pad, idx), dimension=-1, num_keys=2)
+    out_k, _, perm = jax.lax.sort(
+        (masked, is_pad, idx), dimension=-1, num_keys=2, is_stable=stable
+    )
     return out_k, _apply_perm(payload, perm, keys.ndim - 1), jnp.asarray(count, jnp.int32)

@@ -177,7 +177,9 @@ def _merge_received_kv(flat_k, is_pad, num_workers: int, cap_pair: int, merge_ke
         perm = jnp.where(tieb_out < total, tieb_out % total, 0)
         return out_k, perm
     is_pad8 = is_pad.astype(jnp.int8)
-    out_k, _, perm = jax.lax.sort((flat_k, is_pad8, idx), dimension=-1, num_keys=2)
+    out_k, _, perm = jax.lax.sort(
+        (flat_k, is_pad8, idx), dimension=-1, num_keys=2, is_stable=False
+    )
     return out_k, perm
 
 
@@ -197,10 +199,14 @@ def _kv_shard_body(
 
     sent = sentinel_for(keys.dtype)
     count = count[0]
+    # Unstable local sorts: the shuffle interleaves shards, so the kv output
+    # never guaranteed input order among equal keys — take the faster network.
     if sec is None:
-        keys, payload, _ = sort_kv_padded(keys, payload, count)
+        keys, payload, _ = sort_kv_padded(keys, payload, count, stable=False)
     else:
-        keys, sec, payload, _ = sort_kv2_padded(keys, sec, payload, count)
+        keys, sec, payload, _ = sort_kv2_padded(
+            keys, sec, payload, count, stable=False
+        )
     splitters = _choose_splitters(keys, count, num_workers, oversample, axis)
     gidx, valid, lens, overflow = _bucket_slices(keys, count, splitters, cap_pair)
     send_k = jnp.where(valid, keys[gidx], sent)
@@ -227,6 +233,7 @@ def _kv_shard_body(
         (flat_k, is_pad.astype(jnp.int8), recv_s.reshape(-1), idx),
         dimension=-1,
         num_keys=3,
+        is_stable=False,
     )
     out_v = _apply_perm(flat_v, perm, 0)
     return out_k, out_s, out_v, out_count[None], overflow[None]
