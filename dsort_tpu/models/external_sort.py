@@ -29,6 +29,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from dsort_tpu.checkpoint import ShardCheckpoint
+from dsort_tpu.ops.float_order import (
+    float_to_ordered_uint,
+    is_float_key_dtype,
+    ordered_uint_dtype,
+    ordered_uint_to_float,
+)
 from dsort_tpu.ops.local_sort import sentinel_for, sort_with_kernel
 from dsort_tpu.utils.logging import get_logger
 from dsort_tpu.utils.metrics import Metrics, PhaseTimer
@@ -110,6 +116,14 @@ class ExternalSort:
         n = len(data)
         if n == 0:
             return np.asarray(data).copy() if out is None else out
+        # Float keys are NaN-unsafe under sentinel padding (ops.float_order);
+        # map each slice to order-preserving uints as it is read, keep the
+        # spilled runs and the merge in uint space, and unmap at egress in
+        # run-sized chunks so residency stays O(run_elems).
+        fdt = np.dtype(data.dtype) if is_float_key_dtype(data.dtype) else None
+        storage_dtype = ordered_uint_dtype(fdt) if fdt is not None else np.dtype(
+            data.dtype
+        )
         ckpt = ShardCheckpoint(self.spill_dir, self.job_id)
         num_runs = -(-n // self.run_elems)
         fp = _fingerprint(data)
@@ -130,6 +144,11 @@ class ExternalSort:
                 and (
                     m.get("num_shards") != num_runs
                     or m.get("dtype") != str(data.dtype)
+                    # Shards are stored in mapped-uint space for float jobs;
+                    # runs written by a build without the mapping (or with a
+                    # different one) must not be trusted — value-casting them
+                    # through the unmap would silently corrupt the output.
+                    or m.get("storage_dtype") != str(storage_dtype)
                     or m.get("total") != n
                     or m.get("run_elems") != self.run_elems
                     or m.get("fingerprint") != fp
@@ -142,24 +161,52 @@ class ExternalSort:
                 )
                 ckpt.clear()
         ckpt.write_manifest(
-            num_runs, data.dtype, n, run_elems=self.run_elems, fingerprint=fp
+            num_runs,
+            data.dtype,
+            n,
+            run_elems=self.run_elems,
+            fingerprint=fp,
+            storage_dtype=str(storage_dtype),
         )
         with timer.phase("run_generation"):
-            self._generate_runs(data, n, num_runs, ckpt, metrics)
+            self._generate_runs(
+                data,
+                n,
+                num_runs,
+                ckpt,
+                metrics,
+                mapper=float_to_ordered_uint if fdt is not None else None,
+            )
         with timer.phase("merge"):
             runs = [ckpt.load_mmap(i) for i in range(num_runs)]
+            # For float jobs the merge target is a uint view of the caller's
+            # buffer (same width), unmapped in place afterwards.
+            target = out.view(ordered_uint_dtype(fdt)) if (
+                fdt is not None and out is not None
+            ) else out
             if num_runs == 1:
                 # np.array copies: the result must not alias (read-only)
                 # checkpoint files that a later clear() would invalidate.
-                if out is None:
-                    out = np.array(runs[0])
+                if target is None:
+                    target = np.array(runs[0])
                 else:
-                    out[:] = runs[0]
+                    target[:] = runs[0]
             else:
-                out = self._merge(runs, out, metrics)
-        return out
+                target = self._merge(runs, target, metrics)
+            if fdt is not None:
+                if out is None:
+                    return ordered_uint_to_float(target, fdt)
+                for lo in range(0, n, self.run_elems):
+                    sl = slice(lo, min(lo + self.run_elems, n))
+                    # Safe in place: the RHS materializes (np.where output)
+                    # before the slice assignment touches the shared bytes.
+                    out[sl] = ordered_uint_to_float(target[sl], fdt)
+                return out
+            return target if out is None else out
 
-    def _generate_runs(self, data, n, num_runs, ckpt, metrics: Metrics) -> None:
+    def _generate_runs(
+        self, data, n, num_runs, ckpt, metrics: Metrics, mapper=None
+    ) -> None:
         """Sort missing runs with read/compute/write overlap.
 
         The reference's job loop is strictly sequential (read, send, wait,
@@ -187,7 +234,8 @@ class ExternalSort:
             # Memmap slices are lazy views — np.array forces the page faults
             # (the actual disk read) HERE, on the reader thread, so the
             # overlap is real.  In-RAM inputs skip the copy.
-            return np.array(sl) if isinstance(data, np.memmap) else np.asarray(sl)
+            arr = np.array(sl) if isinstance(data, np.memmap) else np.asarray(sl)
+            return mapper(arr) if mapper is not None else arr
 
         with ThreadPoolExecutor(max_workers=1) as reader, ThreadPoolExecutor(
             max_workers=1

@@ -18,6 +18,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dsort_tpu.data.partition import pad_to_shards
+from dsort_tpu.ops.float_order import is_float_key_dtype, sort_float_keys_via_uint
 from dsort_tpu.ops.local_sort import sort_padded
 from dsort_tpu.ops.merge import merge_shards_device, merge_sorted_host
 from dsort_tpu.utils.metrics import Metrics, PhaseTimer
@@ -65,6 +66,11 @@ class GatherMergeSort:
         self._sort_shards = _sort_shards
 
     def sort(self, data: np.ndarray, metrics: Metrics | None = None) -> np.ndarray:
+        data = np.asarray(data)
+        if is_float_key_dtype(data.dtype):
+            # NaN-safe float keys: sort as order-preserving uints (see
+            # ops.float_order) so NaNs are never trimmed as inf pads.
+            return sort_float_keys_via_uint(self.sort, data, metrics)
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         with timer.phase("partition"):

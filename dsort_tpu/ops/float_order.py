@@ -1,0 +1,88 @@
+"""Order-preserving float<->uint key bijection (NaN-safe sorting).
+
+The distributed machinery pads fixed-size buffers with the key dtype's
+maximum and trims by count after sorting (``ops.local_sort`` docstring).  For
+float keys that sentinel is ``inf`` — but IEEE total order places NaN *after*
+inf, so real NaN keys would sort behind the pads and be silently trimmed
+away (and NaN splitters would poison the ``searchsorted`` bucketing).  The
+reference never faces this: its keys are int32 only (``server.c:171-182``).
+
+The fix is the classic radix-sort bit twiddle, applied once at the pipeline
+boundary: map float keys to same-width unsigned ints whose unsigned order
+equals the desired float order, run every distributed/sort/merge phase on
+ints, and map back at egress.
+
+Mapping (float32 shown; float64 is identical with 64-bit constants):
+
+- NaN (any sign, any payload) -> ``0xFFFFFFFF`` — all NaNs order last, like
+  ``np.sort``.  NaN payloads/sign are canonicalized on the way back (one
+  canonical NaN out per NaN in); count and positions are preserved exactly.
+- negative floats (sign bit set) -> ``~bits`` — reverses their order so more
+  negative sorts first; -inf maps near 0.
+- positive floats -> ``bits | 0x8000_0000`` — above every negative; +inf maps
+  just below the NaN slot.  -0.0 orders immediately before +0.0.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SPEC = {
+    np.dtype(np.float16): (np.uint16, np.uint16(0x8000), np.uint16(0xFFFF)),
+    np.dtype(np.float32): (np.uint32, np.uint32(0x80000000), np.uint32(0xFFFFFFFF)),
+    np.dtype(np.float64): (
+        np.uint64,
+        np.uint64(0x8000000000000000),
+        np.uint64(0xFFFFFFFFFFFFFFFF),
+    ),
+}
+
+
+def is_float_key_dtype(dtype) -> bool:
+    """True for key dtypes that need the ordered-uint boundary mapping."""
+    return np.dtype(dtype) in _SPEC
+
+
+def ordered_uint_dtype(float_dtype) -> np.dtype:
+    """The unsigned dtype a float key dtype maps to (same width)."""
+    return np.dtype(_SPEC[np.dtype(float_dtype)][0])
+
+
+def float_to_ordered_uint(x: np.ndarray) -> np.ndarray:
+    """Map a float array to uints whose unsigned order is the float order."""
+    spec = _SPEC.get(np.dtype(x.dtype))
+    if spec is None:
+        raise TypeError(f"not a float key dtype: {x.dtype}")
+    udtype, sign, umax = spec
+    u = np.ascontiguousarray(x).view(udtype)
+    m = np.where(u & sign, ~u, u | sign)
+    return np.where(np.isnan(x), umax, m)
+
+
+def ordered_uint_to_float(m: np.ndarray, float_dtype) -> np.ndarray:
+    """Inverse of `float_to_ordered_uint` (NaNs come back canonical)."""
+    udtype, sign, umax = _SPEC[np.dtype(float_dtype)]
+    m = np.asarray(m)
+    if m.dtype != udtype:
+        # A float (or wrong-width) array here means the caller is unmapping
+        # something that never went through the bijection — value-casting it
+        # would silently corrupt keys, so fail loudly instead.
+        raise TypeError(f"expected {np.dtype(udtype)} mapped keys, got {m.dtype}")
+    u = np.where(m & sign, m ^ sign, ~m)
+    out = np.ascontiguousarray(u).view(float_dtype)
+    return np.where(m == umax, np.array(np.nan, float_dtype), out)
+
+
+def sort_float_keys_via_uint(sort_fn, keys: np.ndarray, *args, **kwargs):
+    """Run a key sort through the bijection: map, sort as uints, unmap.
+
+    ``sort_fn(mapped_keys, *args, **kwargs)`` may return the sorted key array
+    or a tuple whose FIRST element is the sorted key array (kv drivers).
+    This is the one shared float-key boundary wrapper for every driver —
+    keep new entry points on it so none misses the NaN-safety mapping.
+    """
+    keys = np.asarray(keys)
+    out = sort_fn(float_to_ordered_uint(keys), *args, **kwargs)
+    if isinstance(out, tuple):
+        return (ordered_uint_to_float(out[0], keys.dtype),) + out[1:]
+    return ordered_uint_to_float(out, keys.dtype)

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dsort_tpu.config import JobConfig
 from dsort_tpu.data.partition import pad_kv_to_shards, pad_to_shards
+from dsort_tpu.ops.float_order import is_float_key_dtype, sort_float_keys_via_uint
 from dsort_tpu.ops.local_sort import sentinel_for, sort_keys, sort_padded
 from dsort_tpu.utils.logging import get_logger
 from dsort_tpu.utils.metrics import Metrics, PhaseTimer
@@ -316,7 +317,15 @@ class SampleSort:
         return max(cap, 8)
 
     def sort(self, data: np.ndarray, metrics: Metrics | None = None) -> np.ndarray:
-        """Sort a host array; returns the globally sorted host array."""
+        """Sort a host array; returns the globally sorted host array.
+
+        Float keys (incl. NaN/±0.0/±inf) ride the pipeline as order-preserving
+        uints (`ops.float_order`): NaNs sort last like ``np.sort`` and come
+        back canonicalized, never trimmed as pads.
+        """
+        data = np.asarray(data)
+        if is_float_key_dtype(data.dtype):
+            return sort_float_keys_via_uint(self.sort, data, metrics)
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         p = self.num_workers
@@ -374,6 +383,11 @@ class SampleSort:
             log.warning(
                 "merge_kernel='bitonic' is not available with a secondary key; "
                 "using the lax.sort combine"
+            )
+        keys = np.asarray(keys)
+        if is_float_key_dtype(keys.dtype):
+            return sort_float_keys_via_uint(
+                self.sort_kv, keys, payload, metrics, secondary
             )
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)

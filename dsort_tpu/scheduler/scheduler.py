@@ -37,6 +37,7 @@ import numpy as np
 
 from dsort_tpu.config import JobConfig
 from dsort_tpu.data.partition import partition
+from dsort_tpu.ops.float_order import is_float_key_dtype, sort_float_keys_via_uint
 from dsort_tpu.ops.merge import merge_sorted_host
 from dsort_tpu.scheduler.fault import FaultInjector, JobFailedError, WorkerFailure
 from dsort_tpu.scheduler.liveness import WorkerTable
@@ -175,6 +176,11 @@ class Scheduler:
         persist across runs, so re-running a failed job re-sorts only the
         shards that were lost (§5.4 upgrade over restart-the-chunk).
         """
+        data = np.asarray(data)
+        if is_float_key_dtype(data.dtype):
+            # NaN-safe float keys (ops.float_order): workers and the host
+            # merge only ever see order-preserving uints.
+            return sort_float_keys_via_uint(self.run_job, data, metrics, job_id)
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         w = self.executor.num_workers
@@ -278,6 +284,11 @@ class SpmdScheduler:
 
         from dsort_tpu.parallel.sample_sort import SampleSort
 
+        data = np.asarray(data)
+        if is_float_key_dtype(data.dtype):
+            # Map floats before the checkpointed local-sort phase too — a
+            # checkpointed run of raw floats would already have dropped NaNs.
+            return sort_float_keys_via_uint(self.sort, data, metrics, job_id)
         metrics = metrics if metrics is not None else Metrics()
         self.table.revive_all()
         ckpt = None
