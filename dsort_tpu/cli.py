@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -187,6 +188,28 @@ def cmd_terasort(args) -> int:
     from dsort_tpu.parallel.sample_sort import SampleSort
     from dsort_tpu.config import JobConfig
 
+    if args.external:
+        from dsort_tpu.models.external_sort import ExternalTeraSort
+
+        s = ExternalTeraSort(
+            run_recs=args.run_recs,
+            spill_dir=args.spill_dir,
+            job_id=args.job_id,
+            resume=not args.no_resume,
+        )
+        metrics = Metrics()
+        t0 = time.perf_counter()
+        s.sort_file(args.input, args.output or "terasort_out.bin", metrics=metrics)
+        dt = time.perf_counter() - t0
+        n = os.path.getsize(args.input) // ExternalTeraSort.RECORD_BYTES
+        log.info(
+            "terasort (external): %d records in %.1f ms (%.2f Mrec/s) | %s | "
+            "phases: %s",
+            n, dt * 1e3, n / dt / 1e6, dict(metrics.counters),
+            metrics.summary()["phases_ms"],
+        )
+        return 0
+
     keys, payload = read_terasort_file(args.input)
     mesh = local_device_mesh(args.workers)
     job = JobConfig(key_dtype=np.uint64, payload_bytes=payload.shape[1])
@@ -349,6 +372,14 @@ def main(argv=None) -> int:
     p.add_argument("input")
     p.add_argument("-o", "--output")
     p.add_argument("--workers", type=int, default=None)
+    p.add_argument("--external", action="store_true",
+                   help="out-of-core: spill sorted record runs, native merge")
+    p.add_argument("--run-recs", type=int, default=1 << 20,
+                   help="records per spilled run (external mode)")
+    p.add_argument("--spill-dir")
+    p.add_argument("--job-id", default="tera_external")
+    p.add_argument("--no-resume", action="store_true",
+                   help="discard checkpointed runs and start fresh")
     p.set_defaults(fn=cmd_terasort)
 
     p = sub.add_parser("external", help="out-of-core sort of a raw binary key file")

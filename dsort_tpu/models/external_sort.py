@@ -54,6 +54,108 @@ def _fingerprint(data: np.ndarray, samples: int = 16) -> str:
     return f"{n}:{data.dtype}:{picks.tobytes().hex()}"
 
 
+def _overlapped_run_generation(
+    data, n, run_elems, sort_run, ckpt, metrics: Metrics, resume, mapper=None
+) -> None:
+    """Sort missing runs with read/compute/write overlap (shared core).
+
+    The reference's job loop is strictly sequential (read, send, wait,
+    write — ``server.c:171-268``).  Here the next slice's disk read and
+    the previous run's checkpoint write each happen on a background
+    thread while the device sorts the current run, so the pipeline is
+    bounded by max(IO, sort) instead of their sum.  Exceptions from
+    either side surface on the main thread at the next future result.
+    Used by both `ExternalSort` (keys) and `ExternalTeraSort` (records).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    num_runs = -(-n // run_elems)
+    todo = [i for i in range(num_runs) if not (resume and ckpt.has(i))]
+    if len(todo) < num_runs:
+        metrics.bump("runs_resumed", num_runs - len(todo))
+    if not todo:
+        return
+
+    def read_slice(i: int) -> np.ndarray:
+        lo = i * run_elems
+        sl = data[lo : min(lo + run_elems, n)]
+        # Memmap slices are lazy views — np.array forces the page faults
+        # (the actual disk read) HERE, on the reader thread, so the
+        # overlap is real.  In-RAM inputs skip the copy.
+        arr = np.array(sl) if isinstance(data, np.memmap) else np.asarray(sl)
+        return mapper(arr) if mapper is not None else arr
+
+    with ThreadPoolExecutor(max_workers=1) as reader, ThreadPoolExecutor(
+        max_workers=1
+    ) as writer:
+        next_chunk = reader.submit(read_slice, todo[0])
+        pending_write = None
+        for pos, i in enumerate(todo):
+            chunk = next_chunk.result()
+            if pos + 1 < len(todo):
+                next_chunk = reader.submit(read_slice, todo[pos + 1])
+            sorted_run = sort_run(chunk)
+            if pending_write is not None:
+                pending_write.result()  # surface write errors in order
+            pending_write = writer.submit(ckpt.save, i, sorted_run)
+            metrics.bump("runs_sorted")
+        if pending_write is not None:
+            pending_write.result()
+
+
+def _sync_manifest(
+    ckpt: ShardCheckpoint,
+    resume: bool,
+    job_id: str,
+    num_runs: int,
+    dtype,
+    total: int,
+    run_elems: int,
+    fingerprint: str,
+    storage_dtype: str,
+) -> None:
+    """Clear untrusted checkpointed runs, then stamp this job's manifest.
+
+    Trust checkpointed runs only if they came from THIS job: same shard
+    count, dtype, on-disk storage format, run size, and data fingerprint.
+    Otherwise a reused job_id would silently return the previous job's
+    output — or, worse, runs stored in a foreign format (raw floats from a
+    build without the `ops.float_order` mapping, different record layout)
+    would be value-cast into corrupt output.  A missing/unreadable manifest
+    with shards present is equally untrusted (e.g. a crash mid-clear()
+    deleted the manifest first).
+    """
+    if not resume:
+        ckpt.clear()
+    else:
+        m = ckpt.manifest()
+        stale = (m is None and bool(ckpt.completed_shards())) or (
+            m is not None
+            and (
+                m.get("num_shards") != num_runs
+                or m.get("dtype") != str(np.dtype(dtype))
+                or m.get("storage_dtype") != storage_dtype
+                or m.get("total") != total
+                or m.get("run_elems") != run_elems
+                or m.get("fingerprint") != fingerprint
+            )
+        )
+        if stale:
+            log.warning(
+                "job %r: checkpointed runs belong to different data; clearing",
+                job_id,
+            )
+            ckpt.clear()
+    ckpt.write_manifest(
+        num_runs,
+        dtype,
+        total,
+        run_elems=run_elems,
+        fingerprint=fingerprint,
+        storage_dtype=storage_dtype,
+    )
+
+
 class ExternalSort:
     """Sort arrays/files of any size with bounded resident memory.
 
@@ -127,46 +229,9 @@ class ExternalSort:
         ckpt = ShardCheckpoint(self.spill_dir, self.job_id)
         num_runs = -(-n // self.run_elems)
         fp = _fingerprint(data)
-        if not self.resume:
-            ckpt.clear()
-        else:
-            # Trust checkpointed runs only if they came from THIS job: same
-            # shape, dtype, run size, and data fingerprint.  Otherwise a
-            # reused job_id would silently return the previous job's output.
-            # No/unreadable manifest with shards present is equally untrusted
-            # (e.g. a crash mid-clear() deleted the manifest first).
-            m = ckpt.manifest()
-            stale = (
-                m is None
-                and bool(ckpt.completed_shards())
-            ) or (
-                m is not None
-                and (
-                    m.get("num_shards") != num_runs
-                    or m.get("dtype") != str(data.dtype)
-                    # Shards are stored in mapped-uint space for float jobs;
-                    # runs written by a build without the mapping (or with a
-                    # different one) must not be trusted — value-casting them
-                    # through the unmap would silently corrupt the output.
-                    or m.get("storage_dtype") != str(storage_dtype)
-                    or m.get("total") != n
-                    or m.get("run_elems") != self.run_elems
-                    or m.get("fingerprint") != fp
-                )
-            )
-            if stale:
-                log.warning(
-                    "job %r: checkpointed runs belong to different data; clearing",
-                    self.job_id,
-                )
-                ckpt.clear()
-        ckpt.write_manifest(
-            num_runs,
-            data.dtype,
-            n,
-            run_elems=self.run_elems,
-            fingerprint=fp,
-            storage_dtype=str(storage_dtype),
+        _sync_manifest(
+            ckpt, self.resume, self.job_id, num_runs, data.dtype, n,
+            self.run_elems, fp, storage_dtype=str(storage_dtype),
         )
         with timer.phase("run_generation"):
             self._generate_runs(
@@ -207,52 +272,10 @@ class ExternalSort:
     def _generate_runs(
         self, data, n, num_runs, ckpt, metrics: Metrics, mapper=None
     ) -> None:
-        """Sort missing runs with read/compute/write overlap.
-
-        The reference's job loop is strictly sequential (read, send, wait,
-        write — ``server.c:171-268``).  Here the next slice's disk read and
-        the previous run's checkpoint write each happen on a background
-        thread while the device sorts the current run, so the pipeline is
-        bounded by max(IO, sort) instead of their sum.  Exceptions from
-        either side surface on the main thread at the next future result.
-        """
-        from concurrent.futures import ThreadPoolExecutor
-
-        todo = [
-            i
-            for i in range(num_runs)
-            if not (self.resume and ckpt.has(i))
-        ]
-        if len(todo) < num_runs:
-            metrics.bump("runs_resumed", num_runs - len(todo))
-        if not todo:
-            return
-
-        def read_slice(i: int) -> np.ndarray:
-            lo = i * self.run_elems
-            sl = data[lo : min(lo + self.run_elems, n)]
-            # Memmap slices are lazy views — np.array forces the page faults
-            # (the actual disk read) HERE, on the reader thread, so the
-            # overlap is real.  In-RAM inputs skip the copy.
-            arr = np.array(sl) if isinstance(data, np.memmap) else np.asarray(sl)
-            return mapper(arr) if mapper is not None else arr
-
-        with ThreadPoolExecutor(max_workers=1) as reader, ThreadPoolExecutor(
-            max_workers=1
-        ) as writer:
-            next_chunk = reader.submit(read_slice, todo[0])
-            pending_write = None
-            for pos, i in enumerate(todo):
-                chunk = next_chunk.result()
-                if pos + 1 < len(todo):
-                    next_chunk = reader.submit(read_slice, todo[pos + 1])
-                sorted_run = self._sort_run(chunk)
-                if pending_write is not None:
-                    pending_write.result()  # surface write errors in order
-                pending_write = writer.submit(ckpt.save, i, sorted_run)
-                metrics.bump("runs_sorted")
-            if pending_write is not None:
-                pending_write.result()
+        _overlapped_run_generation(
+            data, n, self.run_elems, self._sort_run, ckpt, metrics,
+            resume=self.resume, mapper=mapper,
+        )
 
     def _merge(self, runs, out, metrics: Metrics):
         from dsort_tpu.runtime import native
@@ -301,3 +324,143 @@ class ExternalSort:
         )
         self.sort(data, out=out, metrics=metrics)
         out.flush()
+
+
+class ExternalTeraSort:
+    """Out-of-core TeraSort: 100-byte records bigger than device/host memory.
+
+    The in-memory path (``parallel.SampleSort.sort_kv`` + the CLI ``terasort``
+    command) holds all records at once; this pipeline extends the framework's
+    external sort to TeraSort records (BASELINE config #4 at arbitrary N):
+
+    1. **run generation** — record slices stream in; each slice's full
+       10-byte key (8-byte big-endian-packed primary + 2-byte secondary,
+       ``data.ingest``) is sorted on-chip via the two-level kv kernel
+       (``ops.local_sort.sort_kv2_padded``, unstable — any order of
+       fully-equal keys is a valid TeraSort output) and the reordered raw
+       records spill as checkpointed runs;
+    2. **merge** — the native two-level-key heap merge
+       (``runtime.native.kway_merge_kv2``) streams record runs straight into
+       the output memmap; resident memory is O(total keys) for the heap
+       inputs (10 bytes/record) + O(run) for buffers, never O(total records).
+
+    Resume semantics mirror `ExternalSort` (same manifest/fingerprint rules).
+    """
+
+    RECORD_BYTES = 100
+
+    def __init__(
+        self,
+        run_recs: int = 1 << 20,
+        spill_dir: str | None = None,
+        job_id: str = "tera_external",
+        resume: bool = True,
+    ):
+        if run_recs < 2:
+            raise ValueError("run_recs must be >= 2")
+        import jax
+
+        from dsort_tpu.config import ConfigError
+
+        if not jax.config.jax_enable_x64:
+            # Without x64 jnp.asarray silently truncates the uint64 packed
+            # primary keys to 32 bits — runs would sort by key bytes 4-7 and
+            # the merge would emit mis-sorted output with no error.  Same
+            # guard as JobConfig (config.py) for 8-byte key dtypes.
+            raise ConfigError(
+                "ExternalTeraSort needs 64-bit mode for its uint64 packed "
+                "keys: call jax.config.update('jax_enable_x64', True) first"
+            )
+        self.run_recs = int(run_recs)
+        self.spill_dir = spill_dir or os.path.join(
+            tempfile.gettempdir(), "dsort_external"
+        )
+        self.job_id = job_id
+        self.resume = resume
+        from dsort_tpu.ops.local_sort import sort_kv2_padded
+
+        self._sort_fn = jax.jit(
+            lambda k, s, v, c: sort_kv2_padded(k, s, v, c, stable=False)[2]
+        )
+
+    def _sort_run(self, recs: np.ndarray) -> np.ndarray:
+        """Order one record slice by its full 10-byte key on device."""
+        from dsort_tpu.data.ingest import _pack_be64
+
+        n = len(recs)
+        if n != self.run_recs:  # final partial run: pad to the jitted shape
+            pad = np.zeros((self.run_recs - n, self.RECORD_BYTES), np.uint8)
+            recs = np.concatenate([recs, pad])
+        k1 = _pack_be64(recs[:, :8])
+        k2 = ((recs[:, 8].astype(np.uint16) << np.uint16(8)) | recs[:, 9]).astype(
+            np.uint16
+        )
+        out = np.asarray(
+            self._sort_fn(jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(recs), n)
+        )
+        return out[:n]
+
+    def sort_file(
+        self, in_path: str, out_path: str, metrics: Metrics | None = None
+    ) -> None:
+        """Sort a binary TeraSort file into ``out_path``, out-of-core."""
+        metrics = metrics if metrics is not None else Metrics()
+        timer = PhaseTimer(metrics)
+        size = os.path.getsize(in_path)
+        if size % self.RECORD_BYTES:
+            raise ValueError(
+                f"{in_path}: size {size} not a multiple of {self.RECORD_BYTES}"
+            )
+        n = size // self.RECORD_BYTES
+        if n == 0:
+            open(out_path, "wb").close()
+            return
+        data = np.memmap(in_path, dtype=np.uint8, mode="r").reshape(
+            n, self.RECORD_BYTES
+        )
+        ckpt = ShardCheckpoint(self.spill_dir, self.job_id)
+        num_runs = -(-n // self.run_recs)
+        fp = _fingerprint(data)
+        _sync_manifest(
+            ckpt, self.resume, self.job_id, num_runs, np.uint8, n,
+            self.run_recs, fp, storage_dtype="terasort100",
+        )
+        with timer.phase("run_generation"):
+            self._generate_runs(data, n, num_runs, ckpt, metrics)
+        with timer.phase("merge"):
+            out = np.memmap(
+                out_path, dtype=np.uint8, mode="w+", shape=(n, self.RECORD_BYTES)
+            )
+            runs = [ckpt.load_mmap(i) for i in range(num_runs)]
+            self._merge_runs(runs, out, metrics)
+            out.flush()
+
+    def _generate_runs(self, data, n, num_runs, ckpt, metrics: Metrics) -> None:
+        _overlapped_run_generation(
+            data, n, self.run_recs, self._sort_run, ckpt, metrics,
+            resume=self.resume,
+        )
+
+    def _merge_runs(self, runs, out, metrics: Metrics) -> None:
+        from dsort_tpu.data.ingest import _pack_be64
+        from dsort_tpu.runtime import native
+
+        if len(runs) == 1:
+            out[:] = runs[0]
+            return
+        k1s = [_pack_be64(np.asarray(r[:, :8])) for r in runs]
+        k2s = [
+            ((np.asarray(r[:, 8]).astype(np.uint16) << np.uint16(8)) | r[:, 9]).astype(
+                np.uint16
+            )
+            for r in runs
+        ]
+        if native.available():
+            metrics.bump("native_merges")
+            native.kway_merge_kv2(k1s, k2s, runs, out_v=out)
+            return
+        # Fallback (non-native envs, i.e. tests): in-memory lexsort merge.
+        log.warning("native runtime unavailable; merging terasort runs in memory")
+        allrec = np.concatenate([np.asarray(r) for r in runs])
+        order = np.lexsort((np.concatenate(k2s), np.concatenate(k1s)))
+        out[:] = allrec[order]

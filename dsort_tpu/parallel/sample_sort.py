@@ -379,15 +379,15 @@ class SampleSort:
         uses the ``lax.sort`` merge; ``JobConfig.merge_kernel='bitonic'`` is
         ignored on this path (warned once below).
         """
-        if secondary is not None and self.job.merge_kernel == "bitonic":
-            log.warning(
-                "merge_kernel='bitonic' is not available with a secondary key; "
-                "using the lax.sort combine"
-            )
         keys = np.asarray(keys)
         if is_float_key_dtype(keys.dtype):
             return sort_float_keys_via_uint(
                 self.sort_kv, keys, payload, metrics, secondary
+            )
+        if secondary is not None and self.job.merge_kernel == "bitonic":
+            log.warning(
+                "merge_kernel='bitonic' is not available with a secondary key; "
+                "using the lax.sort combine"
             )
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)

@@ -59,7 +59,7 @@ def _make() -> bool:
 def _open_and_bind():
     lib = ctypes.CDLL(_LIB_PATH)
     # K-way merge signatures.
-    for name in ("i32", "i64", "u64", "u32"):
+    for name in ("i32", "i64", "u64", "u32", "u16"):
         fn = getattr(lib, f"dsort_kway_merge_{name}")
         fn.restype = None
         fn.argtypes = [
@@ -80,6 +80,18 @@ def _open_and_bind():
             ctypes.c_void_p,
             ctypes.c_void_p,
         ]
+    lib.dsort_kway_merge_kv2_u64.restype = None
+    lib.dsort_kway_merge_kv2_u64.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
     lib.dsort_table_create.restype = ctypes.c_void_p
     lib.dsort_table_create.argtypes = [ctypes.c_int32, ctypes.c_double]
     lib.dsort_table_destroy.argtypes = [ctypes.c_void_p]
@@ -167,6 +179,7 @@ _MERGE_FNS = {
     np.dtype(np.int64): "dsort_kway_merge_i64",
     np.dtype(np.uint64): "dsort_kway_merge_u64",
     np.dtype(np.uint32): "dsort_kway_merge_u32",
+    np.dtype(np.uint16): "dsort_kway_merge_u16",
 }
 _MERGE_KV_FNS = {
     np.dtype(np.uint64): "dsort_kway_merge_kv_u64",
@@ -233,6 +246,63 @@ def kway_merge_kv(
     fn(kptrs, vptrs, lens, len(key_runs), pbytes,
        out_k.ctypes.data_as(ctypes.c_void_p), out_v.ctypes.data_as(ctypes.c_void_p))
     return out_k, out_v
+
+
+def kway_merge_kv2(
+    k1_runs: list[np.ndarray],
+    k2_runs: list[np.ndarray],
+    val_runs: list[np.ndarray],
+    out_v: np.ndarray | None = None,
+    want_keys: bool = False,
+) -> tuple[np.ndarray | None, np.ndarray | None, np.ndarray]:
+    """Native merge of record runs ordered by a two-level (u64, u16) key.
+
+    This is the out-of-core TeraSort merge: the full 10-byte key does not
+    fit one machine word, so runs carry an 8-byte big-endian-packed primary
+    (``k1``, uint64) and the 2-byte tail (``k2``, uint16).  Payload rows
+    (typically whole 100-byte records) stream into ``out_v`` — which may be
+    a disk-backed memmap.  Key outputs are skipped unless ``want_keys``
+    (the records already contain their key bytes).
+    """
+    lib = _load()
+    k1_runs = [np.ascontiguousarray(r, dtype=np.uint64) for r in k1_runs]
+    k2_runs = [np.ascontiguousarray(r, dtype=np.uint16) for r in k2_runs]
+    val_runs = [np.ascontiguousarray(r) for r in val_runs]
+    if not (len(k1_runs) == len(k2_runs) == len(val_runs)):
+        raise ValueError("k1/k2/val run counts differ")
+    for k1, k2, v in zip(k1_runs, k2_runs, val_runs):
+        if not (len(k1) == len(k2) == len(v)):
+            raise ValueError(
+                f"run lengths differ: k1={len(k1)} k2={len(k2)} v={len(v)}"
+            )
+    row = val_runs[0].shape[1:]
+    pbytes = int(np.prod(row) * val_runs[0].itemsize)
+    total = sum(len(r) for r in k1_runs)
+    if out_v is None:
+        out_v = np.empty((total,) + row, dtype=val_runs[0].dtype)
+    elif (
+        len(out_v) != total
+        or out_v.shape[1:] != row
+        or out_v.dtype != val_runs[0].dtype
+        or not out_v.flags.c_contiguous
+        or not out_v.flags.writeable
+    ):
+        raise ValueError(
+            f"out_v must be writable C-contiguous {val_runs[0].dtype}"
+            f"[{total}, {row}], got {out_v.dtype}{out_v.shape}"
+        )
+    out_k1 = np.empty(total, np.uint64) if want_keys else None
+    out_k2 = np.empty(total, np.uint16) if want_keys else None
+    k1ptrs, lens = _run_ptrs(k1_runs)
+    k2ptrs, _ = _run_ptrs(k2_runs)
+    vptrs, _ = _run_ptrs(val_runs)
+    lib.dsort_kway_merge_kv2_u64(
+        k1ptrs, k2ptrs, vptrs, lens, len(k1_runs), pbytes,
+        out_k1.ctypes.data_as(ctypes.c_void_p) if want_keys else None,
+        out_k2.ctypes.data_as(ctypes.c_void_p) if want_keys else None,
+        out_v.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out_k1, out_k2, out_v
 
 
 _TEXT_SUFFIX = {

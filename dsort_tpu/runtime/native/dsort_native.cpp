@@ -107,6 +107,45 @@ void kway_merge_kv(const K** kruns, const uint8_t** vruns, const int64_t* lens,
   }
 }
 
+// Two-level key: TeraSort's full 10-byte key as an 8-byte big-endian-packed
+// primary plus a 2-byte secondary (key bytes 8-9).  A single u64 cannot hold
+// all 80 bits, so the heap orders (k1, k2) lexicographically.
+struct Key2 {
+  uint64_t k1;
+  uint16_t k2;
+  bool operator<(const Key2& o) const {
+    return k1 < o.k1 || (k1 == o.k1 && k2 < o.k2);
+  }
+  bool operator<=(const Key2& o) const { return !(o < *this); }
+};
+
+// K-way merge of record runs ordered by the two-level key.  Key outputs are
+// optional (nullptr skips them) — the usual caller only wants the merged
+// 100-byte records, with key bytes already inside the payload.
+void kway_merge_kv2(const uint64_t** k1runs, const uint16_t** k2runs,
+                    const uint8_t** vruns, const int64_t* lens, int32_t nruns,
+                    int32_t pbytes, uint64_t* out_k1, uint16_t* out_k2,
+                    uint8_t* out_v) {
+  RunHeap<Key2> heap(nruns);
+  std::vector<int64_t> pos(nruns, 0);
+  for (int32_t r = 0; r < nruns; ++r) {
+    if (lens[r] > 0) heap.push({k1runs[r][0], k2runs[r][0]}, r);
+  }
+  int64_t o = 0;
+  while (!heap.empty()) {
+    HeapNode<Key2> top = heap.pop();
+    int64_t p = pos[top.run];
+    if (out_k1) out_k1[o] = top.key.k1;
+    if (out_k2) out_k2[o] = top.key.k2;
+    std::memcpy(out_v + o * pbytes, vruns[top.run] + p * pbytes, pbytes);
+    ++o;
+    if (++pos[top.run] < lens[top.run]) {
+      int64_t q = pos[top.run];
+      heap.push({k1runs[top.run][q], k2runs[top.run][q]}, top.run);
+    }
+  }
+}
+
 // ---------------------------------------------------------------------------
 // Worker liveness table.
 // ---------------------------------------------------------------------------
@@ -212,6 +251,14 @@ void dsort_kway_merge_u32(const uint32_t** runs, const int64_t* lens,
   kway_merge<uint32_t>(runs, lens, nruns, out);
 }
 
+// uint16 carries mapped float16 keys (ops.float_order), so out-of-core
+// float16 sorts keep the streaming native merge instead of falling back to
+// an in-memory host merge.
+void dsort_kway_merge_u16(const uint16_t** runs, const int64_t* lens,
+                          int32_t nruns, uint16_t* out) {
+  kway_merge<uint16_t>(runs, lens, nruns, out);
+}
+
 void dsort_kway_merge_kv_u64(const uint64_t** kruns, const uint8_t** vruns,
                              const int64_t* lens, int32_t nruns, int32_t pbytes,
                              uint64_t* out_k, uint8_t* out_v) {
@@ -222,6 +269,14 @@ void dsort_kway_merge_kv_i64(const int64_t** kruns, const uint8_t** vruns,
                              const int64_t* lens, int32_t nruns, int32_t pbytes,
                              int64_t* out_k, uint8_t* out_v) {
   kway_merge_kv<int64_t>(kruns, vruns, lens, nruns, pbytes, out_k, out_v);
+}
+
+void dsort_kway_merge_kv2_u64(const uint64_t** k1runs, const uint16_t** k2runs,
+                              const uint8_t** vruns, const int64_t* lens,
+                              int32_t nruns, int32_t pbytes, uint64_t* out_k1,
+                              uint16_t* out_k2, uint8_t* out_v) {
+  kway_merge_kv2(k1runs, k2runs, vruns, lens, nruns, pbytes, out_k1, out_k2,
+                 out_v);
 }
 
 void* dsort_table_create(int32_t n, double heartbeat_timeout_s) {

@@ -1,5 +1,7 @@
 """Out-of-core external sort: correctness, resume, file-to-file path."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -153,3 +155,108 @@ def test_native_merge_rejects_readonly_out(tmp_path):
     ro.setflags(write=False)
     with pytest.raises(ValueError, match="writable"):
         native.kway_merge(runs, out=ro)
+
+
+def _tera_oracle(path):
+    """Full 10-byte-key record order via np.lexsort (the external oracle)."""
+    from dsort_tpu.data.ingest import _pack_be64
+
+    raw = np.fromfile(path, dtype=np.uint8).reshape(-1, 100)
+    k1 = _pack_be64(raw[:, :8])
+    k2 = (raw[:, 8].astype(np.uint16) << np.uint16(8)) | raw[:, 9]
+    return raw[np.lexsort((k2, k1))]
+
+
+def test_external_terasort_multirun(tmp_path):
+    from dsort_tpu.data.ingest import gen_terasort_file
+    from dsort_tpu.models.external_sort import ExternalTeraSort
+
+    in_path, out_path = str(tmp_path / "t.bin"), str(tmp_path / "t_sorted.bin")
+    gen_terasort_file(in_path, 3000, seed=11)
+    s = ExternalTeraSort(run_recs=512, spill_dir=str(tmp_path / "spill"), job_id="t1")
+    m = Metrics()
+    s.sort_file(in_path, out_path, metrics=m)
+    got = np.fromfile(out_path, dtype=np.uint8).reshape(-1, 100)
+    np.testing.assert_array_equal(got, _tera_oracle(in_path))
+    assert m.counters["runs_sorted"] == 6
+
+
+def test_external_terasort_prefix_collisions(tmp_path):
+    """Records with equal 8-byte prefixes must order by key bytes 8-9."""
+    from dsort_tpu.models.external_sort import ExternalTeraSort
+
+    rng = np.random.default_rng(4)
+    raw = rng.integers(0, 256, (1000, 100)).astype(np.uint8)
+    raw[:, :8] = 7  # every primary collides
+    in_path, out_path = str(tmp_path / "c.bin"), str(tmp_path / "c_sorted.bin")
+    raw.tofile(in_path)
+    s = ExternalTeraSort(run_recs=256, spill_dir=str(tmp_path / "spill"), job_id="t2")
+    s.sort_file(in_path, out_path)
+    got = np.fromfile(out_path, dtype=np.uint8).reshape(-1, 100)
+    np.testing.assert_array_equal(got[:, :10], _tera_oracle(in_path)[:, :10])
+
+
+def test_external_terasort_resume(tmp_path):
+    from dsort_tpu.data.ingest import gen_terasort_file
+    from dsort_tpu.models.external_sort import ExternalTeraSort
+
+    in_path, out_path = str(tmp_path / "r.bin"), str(tmp_path / "r_sorted.bin")
+    gen_terasort_file(in_path, 2000, seed=5)
+    kw = dict(run_recs=512, spill_dir=str(tmp_path / "spill"), job_id="t3")
+    ExternalTeraSort(**kw).sort_file(in_path, out_path)
+    m = Metrics()
+    ExternalTeraSort(**kw).sort_file(in_path, out_path, metrics=m)
+    assert m.counters.get("runs_resumed") == 4 and "runs_sorted" not in m.counters
+    got = np.fromfile(out_path, dtype=np.uint8).reshape(-1, 100)
+    np.testing.assert_array_equal(got, _tera_oracle(in_path))
+
+
+def test_external_terasort_python_fallback_merge(tmp_path, monkeypatch):
+    from dsort_tpu.data.ingest import gen_terasort_file
+    from dsort_tpu.models.external_sort import ExternalTeraSort
+    from dsort_tpu.runtime import native
+
+    monkeypatch.setattr(native, "available", lambda: False)
+    in_path, out_path = str(tmp_path / "f.bin"), str(tmp_path / "f_sorted.bin")
+    gen_terasort_file(in_path, 1500, seed=6)
+    s = ExternalTeraSort(run_recs=400, spill_dir=str(tmp_path / "spill"), job_id="t4")
+    s.sort_file(in_path, out_path)
+    got = np.fromfile(out_path, dtype=np.uint8).reshape(-1, 100)
+    np.testing.assert_array_equal(got, _tera_oracle(in_path))
+
+
+def test_external_terasort_empty_and_partial(tmp_path):
+    from dsort_tpu.data.ingest import gen_terasort_file
+    from dsort_tpu.models.external_sort import ExternalTeraSort
+
+    empty, out_e = str(tmp_path / "e.bin"), str(tmp_path / "e_sorted.bin")
+    open(empty, "wb").close()
+    s = ExternalTeraSort(run_recs=64, spill_dir=str(tmp_path / "spill"), job_id="t5")
+    s.sort_file(empty, out_e)
+    assert os.path.getsize(out_e) == 0
+    # single partial run (n < run_recs)
+    one, out_o = str(tmp_path / "o.bin"), str(tmp_path / "o_sorted.bin")
+    gen_terasort_file(one, 33, seed=7)
+    s2 = ExternalTeraSort(run_recs=64, spill_dir=str(tmp_path / "spill2"), job_id="t6")
+    s2.sort_file(one, out_o)
+    got = np.fromfile(out_o, dtype=np.uint8).reshape(-1, 100)
+    np.testing.assert_array_equal(got, _tera_oracle(one))
+
+
+def test_cli_terasort_external_validates(tmp_path):
+    import subprocess
+    import sys
+
+    in_path = str(tmp_path / "cli.bin")
+    out_path = str(tmp_path / "cli_sorted.bin")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    run = lambda *a: subprocess.run(
+        [sys.executable, "-m", "dsort_tpu.cli", *a],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert run("gen", "600", "-o", in_path, "--dist", "terasort").returncode == 0
+    r = run("terasort", in_path, "-o", out_path, "--external", "--run-recs", "256",
+            "--spill-dir", str(tmp_path / "spill"))
+    assert r.returncode == 0, r.stderr
+    v = run("validate", out_path, "--against", in_path, "--terasort")
+    assert v.returncode == 0, v.stdout + v.stderr

@@ -19,7 +19,9 @@ pytestmark = pytest.mark.skipif(
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint64, np.uint32])
+@pytest.mark.parametrize(
+    "dtype", [np.int32, np.int64, np.uint64, np.uint32, np.uint16]
+)
 def test_native_kway_merge_parity(dtype):
     rng = np.random.default_rng(1)
     info = np.iinfo(dtype)
@@ -201,3 +203,35 @@ def test_jax_worker_int64_cluster():
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_native_kway_merge_kv2_two_level_order():
+    """Records merge by (u64 primary, u16 secondary) — ties break exactly."""
+    rng = np.random.default_rng(9)
+    k1s, k2s, vs = [], [], []
+    for n in (300, 0, 77):
+        k1 = rng.integers(0, 8, n).astype(np.uint64)  # heavy primary ties
+        k2 = rng.integers(0, 2**16, n).astype(np.uint16)
+        order = np.lexsort((k2, k1))
+        k1, k2 = k1[order], k2[order]
+        v = rng.integers(0, 255, (n, 10)).astype(np.uint8)
+        v[:, 0] = (k2 % 251).astype(np.uint8)
+        k1s.append(k1); k2s.append(k2); vs.append(v)
+    ok1, ok2, ov = native.kway_merge_kv2(k1s, k2s, vs, want_keys=True)
+    a1, a2 = np.concatenate(k1s), np.concatenate(k2s)
+    order = np.lexsort((a2, a1))
+    np.testing.assert_array_equal(ok1, a1[order])
+    np.testing.assert_array_equal(ok2, a2[order])
+    np.testing.assert_array_equal(ov[:, 0], (ok2 % 251).astype(np.uint8))
+
+
+def test_native_kway_merge_kv2_rejects_bad_buffers():
+    k1 = [np.array([1, 2], np.uint64)]
+    k2 = [np.array([0, 0], np.uint16)]
+    v = [np.zeros((2, 8), np.uint8)]
+    with pytest.raises(ValueError):  # wrong row width
+        native.kway_merge_kv2(k1, k2, v, out_v=np.zeros((2, 4), np.uint8))
+    with pytest.raises(ValueError):  # wrong dtype
+        native.kway_merge_kv2(k1, k2, v, out_v=np.zeros((2, 8), np.uint16))
+    with pytest.raises(ValueError):  # mismatched run lengths
+        native.kway_merge_kv2(k1, [np.array([0], np.uint16)], v)
