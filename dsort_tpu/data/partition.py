@@ -34,16 +34,21 @@ def partition(data: np.ndarray, num_workers: int) -> list[np.ndarray]:
 
 
 def pad_to_shards(
-    data: np.ndarray, num_workers: int, multiple: int = 8
+    data: np.ndarray, num_workers: int, multiple: int = 8, cap: int | None = None
 ) -> tuple[np.ndarray, np.ndarray]:
     """Lay ``data`` out as ``(num_workers, cap)`` + per-shard valid counts.
 
     ``cap`` is the max chunk size rounded up to ``multiple`` (TPU-friendly
     alignment); pads hold the dtype sentinel.  This is the static-shape
     successor of the reference's malloc'd variable chunks (``server.c:206-216``).
+    An explicit ``cap`` overrides the computed one — multi-host drivers must
+    agree on one global cap even when hosts hold unequal data amounts.
     """
     sizes = equal_partition(len(data), num_workers)
-    cap = -(-max(sizes + [1]) // multiple) * multiple
+    if cap is None:
+        cap = -(-max(sizes + [1]) // multiple) * multiple
+    elif cap < max(sizes + [0]):
+        raise ValueError(f"cap {cap} < largest shard {max(sizes)}")
     out = np.full((num_workers, cap), sentinel_for(data.dtype), dtype=data.dtype)
     off = 0
     for i, s in enumerate(sizes):

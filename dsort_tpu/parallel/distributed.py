@@ -66,3 +66,109 @@ def global_worker_mesh(axis_name: str = "w"):
     import numpy as np
 
     return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
+    """Pod-wide sort with per-host ingest/egress (call from EVERY process).
+
+    Each process contributes its host-local key array; the SPMD sample-sort
+    program runs over the global mesh (ICI within a slice, DCN across
+    hosts), and each process receives back the contiguous slice of the
+    globally sorted, range-partitioned output that its own devices own —
+    data never funnels through one host, unlike the reference's master,
+    which ingests the whole file and merges every chunk itself
+    (``server.c:171-216,481-524``).
+
+    All processes must make identical calls (same ``job``); capacity-retry
+    decisions replicate via a global any-overflow reduction, so the retry
+    loop stays in lockstep.  Returns ``(local_sorted, global_offset)``:
+    this process's slice and its start position in the global output.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.partition import pad_to_shards
+    from dsort_tpu.ops.float_order import (
+        is_float_key_dtype,
+        sort_float_keys_via_uint,
+    )
+    from dsort_tpu.utils.metrics import Metrics
+
+    local_data = np.asarray(local_data)
+    if is_float_key_dtype(local_data.dtype):
+        out, off = sort_float_keys_via_uint(
+            sort_local_shards, local_data, job, axis_name, metrics
+        )
+        return out, off
+    job = job or JobConfig()
+    metrics = metrics if metrics is not None else Metrics()
+    mesh = global_worker_mesh(axis_name)
+    p_total = int(mesh.shape[axis_name])
+    n_local_devices = len(jax.local_devices())
+
+    # Hosts may hold unequal amounts; agree on one global per-device cap.
+    my_cap = -(-max(len(local_data), 1) // (8 * n_local_devices)) * 8
+    caps = multihost_utils.process_allgather(np.asarray([my_cap], np.int64))
+    cap = int(np.max(caps))
+    shards, counts = pad_to_shards(local_data, n_local_devices, cap=cap)
+
+    sharding = NamedSharding(mesh, P(axis_name))
+    xs = jax.make_array_from_process_local_data(sharding, shards.reshape(-1))
+    cj = jax.make_array_from_process_local_data(sharding, counts)
+
+    import functools
+
+    from dsort_tpu.parallel.sample_sort import _sample_sort_shard
+
+    replicated = NamedSharding(mesh, P())
+    any_overflow = jax.jit(jnp.any, out_shardings=replicated)
+    factor = job.capacity_factor
+    for _ in range(job.max_capacity_retries + 1):
+        cap_pair = max(-(-int(np.ceil(factor * cap / p_total)) // 8) * 8, 8)
+        fn = jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    _sample_sort_shard,
+                    num_workers=p_total,
+                    oversample=job.oversample,
+                    cap_pair=cap_pair,
+                    axis=axis_name,
+                    kernel=job.local_kernel,
+                    merge_kernel=job.merge_kernel,
+                ),
+                mesh=mesh,
+                in_specs=(P(axis_name), P(axis_name)),
+                out_specs=(P(axis_name), P(axis_name), P(axis_name)),
+                check_vma=False,
+            )
+        )
+        merged, out_counts, overflow = fn(xs, cj)
+        if not bool(any_overflow(overflow)):  # replicated: consistent everywhere
+            break
+        metrics.bump("capacity_retries")
+        factor *= 2.0
+        log.warning("multihost bucket overflow: retrying with factor=%.1f", factor)
+    else:
+        raise RuntimeError("sample sort bucket overflow after max retries")
+
+    # Per-host egress: read only this process's addressable shards, in
+    # global device order, and trim each device's run to its valid count.
+    def _local_rows(garr):
+        rows = sorted(garr.addressable_shards, key=lambda s: s.index[0].start)
+        return [np.asarray(s.data).reshape(-1) for s in rows], rows[0].index[0].start
+
+    count_rows, _ = _local_rows(out_counts)
+    merged_rows, merged_start = _local_rows(merged)
+    local_counts = np.concatenate(count_rows)
+    local_sorted = np.concatenate(
+        [r[: int(c)] for r, c in zip(merged_rows, local_counts)]
+    )
+    # Global offset of this host's slice = total valid keys on earlier devices.
+    all_counts = multihost_utils.process_allgather(local_counts)
+    first_dev = merged_start // merged_rows[0].shape[0] if merged_rows[0].size else 0
+    flat_counts = np.asarray(all_counts).reshape(-1)
+    offset = int(flat_counts[:first_dev].sum())
+    return local_sorted, offset

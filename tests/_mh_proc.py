@@ -1,0 +1,49 @@
+"""One process of the multi-host sort test cluster (not a test module).
+
+Spawned by tests/test_multihost.py: joins a 2-process JAX CPU cluster
+(collectives over the Gloo/DCN path — the CPU stand-in for a real pod),
+contributes host-local data to `parallel.distributed.sort_local_shards`,
+and writes its slice of the global output for the parent to verify.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid, port, outdir, dtype = (
+        int(sys.argv[1]),
+        sys.argv[2],
+        sys.argv[3],
+        sys.argv[4],
+    )
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+
+    import numpy as np
+
+    from dsort_tpu.parallel.distributed import sort_local_shards
+
+    rng = np.random.default_rng(100 + pid)
+    n = 4000 + 1000 * pid  # deliberately unequal host loads
+    if dtype == "float32nan":
+        data = rng.normal(size=n).astype(np.float32)
+        data[::97] = np.nan
+    else:
+        data = rng.integers(-(10**6), 10**6, n).astype(dtype)
+    out, off = sort_local_shards(data)
+    np.save(os.path.join(outdir, f"in_{pid}.npy"), data)
+    np.save(os.path.join(outdir, f"out_{pid}.npy"), out)
+    with open(os.path.join(outdir, f"meta_{pid}.json"), "w") as f:
+        json.dump({"offset": off}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Multi-host (multi-process) distributed sort over the DCN path.
+
+The reference's "multi-node" test strategy is several processes on one
+machine talking TCP (SURVEY.md §4).  The TPU-native equivalent: a REAL
+2-process JAX cluster (``jax.distributed.initialize`` on the CPU backend,
+cross-process collectives over Gloo — the same code path that rides DCN on
+a pod), each process feeding host-local data into
+`parallel.distributed.sort_local_shards` and getting back its own devices'
+slice of the globally sorted, range-partitioned output.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PROC = os.path.join(REPO, "tests", "_mh_proc.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_cluster(tmp_path, dtype: str) -> None:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _PROC, str(pid), str(port), str(tmp_path), dtype],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        for pid in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err.decode()[-2000:]
+
+
+def _check(tmp_path, sort_like_numpy) -> None:
+    ins = [np.load(tmp_path / f"in_{i}.npy") for i in range(2)]
+    outs = [np.load(tmp_path / f"out_{i}.npy") for i in range(2)]
+    offs = [
+        json.load(open(tmp_path / f"meta_{i}.json"))["offset"] for i in range(2)
+    ]
+    got = np.concatenate(outs)
+    allin = np.concatenate(ins)
+    assert len(got) == len(allin)
+    # Offsets stitch the slices back contiguously in global order.
+    assert offs[0] == 0 and offs[1] == len(outs[0])
+    sort_like_numpy(got, allin)
+
+
+def test_two_process_cluster_int32(tmp_path):
+    _run_cluster(tmp_path, "int32")
+    _check(
+        tmp_path,
+        lambda got, allin: np.testing.assert_array_equal(got, np.sort(allin)),
+    )
+
+
+def test_two_process_cluster_float32_nan(tmp_path):
+    """NaN float keys survive the multi-host path too (boundary bijection)."""
+    _run_cluster(tmp_path, "float32nan")
+
+    def check(got, allin):
+        expect = np.sort(allin)  # numpy: NaNs last
+        k = len(allin) - np.isnan(allin).sum()
+        np.testing.assert_array_equal(got[:k], expect[:k])
+        assert np.isnan(got[k:]).all()
+
+    _check(tmp_path, check)
