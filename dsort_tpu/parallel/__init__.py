@@ -1,4 +1,8 @@
 """SPMD parallelism: mesh construction and collective sort algorithms."""
 
+from dsort_tpu.parallel.distributed import (  # noqa: F401
+    initialize_multihost,
+    sort_local_shards,
+)
 from dsort_tpu.parallel.mesh import make_mesh, local_device_mesh  # noqa: F401
 from dsort_tpu.parallel.sample_sort import SampleSort  # noqa: F401

@@ -120,14 +120,19 @@ def test_sample_sort_kv_secondary_with_capacity_retry(mesh8):
     assert sorted(map(bytes, sv)) == sorted(map(bytes, payload))
 
 
-@pytest.mark.parametrize("dtype", [np.uint32, np.float32, np.float64])
+@pytest.mark.parametrize(
+    "dtype",
+    [np.uint32, np.float32, np.float64, np.int8, np.uint8, np.int16, np.uint16],
+)
 def test_sample_sort_more_dtypes(mesh8, dtype):
     rng = np.random.default_rng(41)
     if np.issubdtype(dtype, np.floating):
         data = (rng.standard_normal(10_000) * 1e6).astype(dtype)
     else:
-        data = rng.integers(0, np.iinfo(dtype).max, 10_000, dtype=dtype)
+        info = np.iinfo(dtype)
+        data = rng.integers(info.min, info.max, 10_000).astype(dtype)
     out = SampleSort(mesh8, JobConfig(key_dtype=dtype)).sort(data)
+    assert out.dtype == data.dtype
     np.testing.assert_array_equal(out, np.sort(data))
 
 
