@@ -100,9 +100,17 @@ def _run_one(sorter, in_path: str, out_path: str, dtype) -> None:
 
 
 def cmd_run(args) -> int:
+    from dsort_tpu.utils.tracing import profile_trace
+
     cfg = _load_config(args)
     sorter = _make_sorter(cfg, args.mode)
-    _run_one(sorter, args.input, args.output or cfg.output_path, np.dtype(cfg.job.key_dtype))
+    with profile_trace(getattr(args, "profile_dir", None)):
+        _run_one(
+            sorter, args.input, args.output or cfg.output_path,
+            np.dtype(cfg.job.key_dtype),
+        )
+    if getattr(args, "profile_dir", None):
+        log.info("profiler trace written to %s", args.profile_dir)
     return 0
 
 
@@ -346,6 +354,8 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("run", help="sort one file")
     p.add_argument("input")
+    p.add_argument("--profile-dir",
+                   help="capture a jax.profiler trace of the job here")
     common(p)
     p.set_defaults(fn=cmd_run)
 

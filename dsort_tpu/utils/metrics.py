@@ -50,15 +50,24 @@ class Metrics:
 
 
 class PhaseTimer:
-    """Context-manager timer feeding a `Metrics` object."""
+    """Context-manager timer feeding a `Metrics` object.
+
+    Each phase is also emitted as a ``jax.profiler`` trace annotation
+    (`utils.tracing.annotate`), so when a profile capture is active
+    (``dsort run --profile-dir`` / `tracing.profile_trace`) the host-side
+    phases line up against device ops in the TensorBoard/Perfetto timeline.
+    """
 
     def __init__(self, metrics: Metrics):
         self.metrics = metrics
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        from dsort_tpu.utils.tracing import annotate
+
         t0 = time.perf_counter()
         try:
-            yield
+            with annotate(f"dsort:{name}"):
+                yield
         finally:
             self.metrics.add(name, time.perf_counter() - t0)
