@@ -24,11 +24,17 @@ def profile_trace(logdir: str | None):
 
 @contextlib.contextmanager
 def annotate(name: str):
-    """Label a host-side region in profiler timelines (no-op off-profile)."""
+    """Label a host-side region in profiler timelines (no-op off-profile).
+
+    Only the annotation SETUP is guarded — exceptions raised by the body
+    must propagate (a fault-tolerance path relies on JobFailedError crossing
+    phase boundaries), so no try/except may wrap the ``yield``.
+    """
     try:
         import jax
 
-        with jax.profiler.TraceAnnotation(name):
-            yield
+        cm = jax.profiler.TraceAnnotation(name)
     except Exception:
+        cm = contextlib.nullcontext()
+    with cm:
         yield

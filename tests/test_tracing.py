@@ -47,3 +47,20 @@ def test_cli_run_profile_dir(tmp_path):
     )
     assert r.returncode == 0, r.stderr
     assert os.path.isdir(prof) and any(os.scandir(prof))
+
+
+def test_annotate_propagates_body_exceptions():
+    """Regression: a try/except wrapping the yield swallowed body exceptions,
+    breaking JobFailedError propagation across PhaseTimer phases."""
+    import pytest
+
+    from dsort_tpu.utils.metrics import Metrics, PhaseTimer
+    from dsort_tpu.utils.tracing import annotate
+
+    with pytest.raises(RuntimeError, match="boom"):
+        with annotate("x"):
+            raise RuntimeError("boom")
+    t = PhaseTimer(Metrics())
+    with pytest.raises(RuntimeError, match="boom"):
+        with t.phase("p"):
+            raise RuntimeError("boom")
