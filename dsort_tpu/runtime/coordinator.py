@@ -97,6 +97,11 @@ class NativeCoordinator:
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         data = np.asarray(data)
+        # Float keys need no ops.float_order mapping here: this path has no
+        # sentinel padding (shards are exact-size), workers sort NaNs last
+        # (lax/np total order), and the host merge falls back to numpy's
+        # NaN-last sort — mapping would also break the workers' spawn-time
+        # --dtype frame contract, which the coordinator cannot renegotiate.
         with timer.phase("partition"):
             shards = partition(data, num_shards)
         with timer.phase("dispatch"):

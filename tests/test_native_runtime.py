@@ -235,3 +235,31 @@ def test_native_kway_merge_kv2_rejects_bad_buffers():
         native.kway_merge_kv2(k1, k2, v, out_v=np.zeros((2, 8), np.uint16))
     with pytest.raises(ValueError):  # mismatched run lengths
         native.kway_merge_kv2(k1, [np.array([0], np.uint16)], v)
+
+
+def test_coordinator_float_nan_cluster():
+    """Float keys with NaNs through a real worker cluster: no sentinel
+    padding on this path, so NaNs must survive and order last (np.sort
+    semantics) without the ops.float_order mapping — which would break the
+    workers' spawn-time --dtype frame contract."""
+    from dsort_tpu.runtime import NativeCoordinator
+
+    coord = NativeCoordinator(port=0, heartbeat_timeout_s=10.0)
+    procs = _spawn_workers(coord.port, 2, dtype="float32")
+    try:
+        coord.wait_workers(2, timeout_s=30.0)
+        rng = np.random.default_rng(12)
+        data = rng.normal(size=8_000).astype(np.float32)
+        data[::101] = np.nan
+        out = coord.run_job(data, num_shards=2)
+        expect = np.sort(data)  # NaNs last
+        k = len(data) - np.isnan(data).sum()
+        np.testing.assert_array_equal(out[:k], expect[:k])
+        assert np.isnan(out[k:]).all()
+    finally:
+        coord.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
