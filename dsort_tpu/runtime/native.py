@@ -68,6 +68,15 @@ def _open_and_bind():
             ctypes.c_int32,
             ctypes.c_void_p,
         ]
+        par = getattr(lib, f"dsort_kway_merge_par_{name}")
+        par.restype = None
+        par.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_int32,
+        ]
     for name in ("u64", "i64"):
         fn = getattr(lib, f"dsort_kway_merge_kv_{name}")
         fn.restype = None
@@ -198,16 +207,22 @@ def _run_ptrs(runs: list[np.ndarray]):
     return ptrs, lens
 
 
-def kway_merge(runs: list[np.ndarray], out: np.ndarray | None = None) -> np.ndarray:
+def kway_merge(
+    runs: list[np.ndarray],
+    out: np.ndarray | None = None,
+    threads: int | None = None,
+) -> np.ndarray:
     """Heap k-way merge of sorted runs in native code.
 
     ``out``, if given, receives the merge in place (it may be a disk-backed
     ``np.memmap`` — the out-of-core egress path of `models.external_sort`).
+    Large merges (>= 2^20 elements, >= 2 runs) range-partition the output by
+    key splitters and merge on ``threads`` std::threads (default: the host's
+    core count, capped at 16); pass ``threads=1`` to force the serial path.
     """
     lib = _load()
     runs = [np.ascontiguousarray(r) for r in runs]
     dtype = runs[0].dtype
-    fn = getattr(lib, _MERGE_FNS[dtype])
     total = sum(len(r) for r in runs)
     if out is None:
         out = np.empty(total, dtype=dtype)
@@ -221,8 +236,15 @@ def kway_merge(runs: list[np.ndarray], out: np.ndarray | None = None) -> np.ndar
             f"out must be writable C-contiguous {dtype}[{total}], "
             f"got {out.dtype}[{len(out)}]"
         )
+    if threads is None:
+        threads = min(os.cpu_count() or 1, 16)
     ptrs, lens = _run_ptrs(runs)
-    fn(ptrs, lens, len(runs), out.ctypes.data_as(ctypes.c_void_p))
+    if threads > 1:
+        fn = getattr(lib, _MERGE_FNS[dtype].replace("merge_", "merge_par_"))
+        fn(ptrs, lens, len(runs), out.ctypes.data_as(ctypes.c_void_p), threads)
+    else:
+        fn = getattr(lib, _MERGE_FNS[dtype])
+        fn(ptrs, lens, len(runs), out.ctypes.data_as(ctypes.c_void_p))
     return out
 
 

@@ -16,9 +16,11 @@
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -105,6 +107,64 @@ void kway_merge_kv(const K** kruns, const uint8_t** vruns, const int64_t* lens,
     if (++pos[top.run] < lens[top.run])
       heap.push(kruns[top.run][pos[top.run]], top.run);
   }
+}
+
+// Parallel k-way merge: range-partition the OUTPUT by key splitters, then
+// heap-merge each range on its own thread.  Splitter t is the median of the
+// runs' t/T-quantile elements — medians of coordinate-wise nondecreasing
+// vectors are nondecreasing, so range starts are monotone and every range
+// is a valid contiguous slice of each run (ties land left of the splitter
+// via lower_bound on every run consistently).  Balance is approximate
+// (exact balance is unnecessary for correctness or near-linear speedup).
+template <typename K>
+void kway_merge_parallel(const K** runs, const int64_t* lens, int32_t nruns,
+                         K* out, int32_t nthreads) {
+  int64_t total = 0;
+  for (int32_t r = 0; r < nruns; ++r) total += lens[r];
+  if (nthreads <= 1 || total < (1 << 20) || nruns < 2) {
+    kway_merge<K>(runs, lens, nruns, out);
+    return;
+  }
+  // Boundary positions per (thread, run): bounds[t][r], plus the final end.
+  std::vector<std::vector<int64_t>> bounds(nthreads + 1,
+                                           std::vector<int64_t>(nruns, 0));
+  for (int32_t r = 0; r < nruns; ++r) bounds[nthreads][r] = lens[r];
+  for (int32_t t = 1; t < nthreads; ++t) {
+    std::vector<K> cands;
+    cands.reserve(nruns);
+    for (int32_t r = 0; r < nruns; ++r) {
+      if (lens[r] > 0) cands.push_back(runs[r][lens[r] * t / nthreads]);
+    }
+    if (cands.empty()) continue;
+    std::nth_element(cands.begin(), cands.begin() + cands.size() / 2,
+                     cands.end());
+    K split = cands[cands.size() / 2];
+    for (int32_t r = 0; r < nruns; ++r) {
+      bounds[t][r] =
+          std::lower_bound(runs[r], runs[r] + lens[r], split) - runs[r];
+    }
+  }
+  std::vector<std::thread> ths;
+  int64_t offset = 0;
+  for (int32_t t = 0; t < nthreads; ++t) {
+    std::vector<const K*> sub(nruns);
+    std::vector<int64_t> sublen(nruns);
+    int64_t range = 0;
+    for (int32_t r = 0; r < nruns; ++r) {
+      sub[r] = runs[r] + bounds[t][r];
+      sublen[r] = bounds[t + 1][r] - bounds[t][r];
+      range += sublen[r];
+    }
+    if (range > 0) {
+      ths.emplace_back(
+          [sub = std::move(sub), sublen = std::move(sublen), nruns,
+           dst = out + offset]() mutable {
+            kway_merge<K>(sub.data(), sublen.data(), nruns, dst);
+          });
+    }
+    offset += range;
+  }
+  for (auto& th : ths) th.join();
 }
 
 // Two-level key: TeraSort's full 10-byte key as an 8-byte big-endian-packed
@@ -257,6 +317,31 @@ void dsort_kway_merge_u32(const uint32_t** runs, const int64_t* lens,
 void dsort_kway_merge_u16(const uint16_t** runs, const int64_t* lens,
                           int32_t nruns, uint16_t* out) {
   kway_merge<uint16_t>(runs, lens, nruns, out);
+}
+
+void dsort_kway_merge_par_i32(const int32_t** runs, const int64_t* lens,
+                              int32_t nruns, int32_t* out, int32_t nthreads) {
+  kway_merge_parallel<int32_t>(runs, lens, nruns, out, nthreads);
+}
+
+void dsort_kway_merge_par_i64(const int64_t** runs, const int64_t* lens,
+                              int32_t nruns, int64_t* out, int32_t nthreads) {
+  kway_merge_parallel<int64_t>(runs, lens, nruns, out, nthreads);
+}
+
+void dsort_kway_merge_par_u64(const uint64_t** runs, const int64_t* lens,
+                              int32_t nruns, uint64_t* out, int32_t nthreads) {
+  kway_merge_parallel<uint64_t>(runs, lens, nruns, out, nthreads);
+}
+
+void dsort_kway_merge_par_u32(const uint32_t** runs, const int64_t* lens,
+                              int32_t nruns, uint32_t* out, int32_t nthreads) {
+  kway_merge_parallel<uint32_t>(runs, lens, nruns, out, nthreads);
+}
+
+void dsort_kway_merge_par_u16(const uint16_t** runs, const int64_t* lens,
+                              int32_t nruns, uint16_t* out, int32_t nthreads) {
+  kway_merge_parallel<uint16_t>(runs, lens, nruns, out, nthreads);
 }
 
 void dsort_kway_merge_kv_u64(const uint64_t** kruns, const uint8_t** vruns,

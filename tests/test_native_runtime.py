@@ -263,3 +263,22 @@ def test_coordinator_float_nan_cluster():
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint64, np.uint16])
+def test_native_parallel_merge_parity(dtype):
+    """Range-partitioned threaded merge == serial merge == np.sort, across
+    empty runs, unequal lengths, and heavy duplicates (degenerate splitters)."""
+    rng = np.random.default_rng(7)
+    info = np.iinfo(dtype)
+    for sizes, lo, hi in [
+        ((1 << 20, 300_000, 0, 7), info.min, info.max),
+        ((400_000, 400_001, 399_999, 1), info.min, info.max),
+        ((800_000,) * 5, 0, 3),  # heavy dups: splitters all collide
+    ]:
+        runs = [np.sort(rng.integers(lo, hi, n, dtype=dtype)) for n in sizes]
+        expect = np.sort(np.concatenate(runs))
+        for th in (1, 4, 7):
+            np.testing.assert_array_equal(
+                native.kway_merge(runs, threads=th), expect
+            )
