@@ -101,6 +101,10 @@ def _open_and_bind():
         ctypes.c_void_p,
         ctypes.c_void_p,
     ]
+    lib.dsort_kway_merge_kv2_par_u64.restype = None
+    lib.dsort_kway_merge_kv2_par_u64.argtypes = (
+        lib.dsort_kway_merge_kv2_u64.argtypes + [ctypes.c_int32]
+    )
     lib.dsort_table_create.restype = ctypes.c_void_p
     lib.dsort_table_create.argtypes = [ctypes.c_int32, ctypes.c_double]
     lib.dsort_table_destroy.argtypes = [ctypes.c_void_p]
@@ -276,6 +280,7 @@ def kway_merge_kv2(
     val_runs: list[np.ndarray],
     out_v: np.ndarray | None = None,
     want_keys: bool = False,
+    threads: int | None = None,
 ) -> tuple[np.ndarray | None, np.ndarray | None, np.ndarray]:
     """Native merge of record runs ordered by a two-level (u64, u16) key.
 
@@ -315,15 +320,21 @@ def kway_merge_kv2(
         )
     out_k1 = np.empty(total, np.uint64) if want_keys else None
     out_k2 = np.empty(total, np.uint16) if want_keys else None
+    if threads is None:
+        threads = min(os.cpu_count() or 1, 16)
     k1ptrs, lens = _run_ptrs(k1_runs)
     k2ptrs, _ = _run_ptrs(k2_runs)
     vptrs, _ = _run_ptrs(val_runs)
-    lib.dsort_kway_merge_kv2_u64(
+    args = (
         k1ptrs, k2ptrs, vptrs, lens, len(k1_runs), pbytes,
         out_k1.ctypes.data_as(ctypes.c_void_p) if want_keys else None,
         out_k2.ctypes.data_as(ctypes.c_void_p) if want_keys else None,
         out_v.ctypes.data_as(ctypes.c_void_p),
     )
+    if threads > 1:
+        lib.dsort_kway_merge_kv2_par_u64(*args, threads)
+    else:
+        lib.dsort_kway_merge_kv2_u64(*args)
     return out_k1, out_k2, out_v
 
 

@@ -206,6 +206,82 @@ void kway_merge_kv2(const uint64_t** k1runs, const uint16_t** k2runs,
   }
 }
 
+int64_t lower_bound_pair(const uint64_t* k1, const uint16_t* k2, int64_t len,
+                         Key2 v) {
+  int64_t lo = 0, hi = len;
+  while (lo < hi) {
+    int64_t m = lo + (hi - lo) / 2;
+    if (Key2{k1[m], k2[m]} < v) lo = m + 1;
+    else hi = m;
+  }
+  return lo;
+}
+
+// Threaded variant of the record merge: same output range partitioning as
+// kway_merge_parallel, with splitters and boundaries on the (k1, k2) pair.
+void kway_merge_kv2_parallel(const uint64_t** k1runs, const uint16_t** k2runs,
+                             const uint8_t** vruns, const int64_t* lens,
+                             int32_t nruns, int32_t pbytes, uint64_t* out_k1,
+                             uint16_t* out_k2, uint8_t* out_v,
+                             int32_t nthreads) {
+  int64_t total = 0;
+  for (int32_t r = 0; r < nruns; ++r) total += lens[r];
+  if (nthreads <= 1 || total < (1 << 18) || nruns < 2) {
+    kway_merge_kv2(k1runs, k2runs, vruns, lens, nruns, pbytes, out_k1, out_k2,
+                   out_v);
+    return;
+  }
+  std::vector<std::vector<int64_t>> bounds(nthreads + 1,
+                                           std::vector<int64_t>(nruns, 0));
+  for (int32_t r = 0; r < nruns; ++r) bounds[nthreads][r] = lens[r];
+  for (int32_t t = 1; t < nthreads; ++t) {
+    std::vector<Key2> cands;
+    cands.reserve(nruns);
+    for (int32_t r = 0; r < nruns; ++r) {
+      if (lens[r] > 0) {
+        int64_t q = lens[r] * t / nthreads;
+        cands.push_back({k1runs[r][q], k2runs[r][q]});
+      }
+    }
+    if (cands.empty()) continue;
+    std::nth_element(cands.begin(), cands.begin() + cands.size() / 2,
+                     cands.end());
+    Key2 split = cands[cands.size() / 2];
+    for (int32_t r = 0; r < nruns; ++r) {
+      bounds[t][r] = lower_bound_pair(k1runs[r], k2runs[r], lens[r], split);
+    }
+  }
+  std::vector<std::thread> ths;
+  int64_t offset = 0;
+  for (int32_t t = 0; t < nthreads; ++t) {
+    std::vector<const uint64_t*> s1(nruns);
+    std::vector<const uint16_t*> s2(nruns);
+    std::vector<const uint8_t*> sv(nruns);
+    std::vector<int64_t> sublen(nruns);
+    int64_t range = 0;
+    for (int32_t r = 0; r < nruns; ++r) {
+      s1[r] = k1runs[r] + bounds[t][r];
+      s2[r] = k2runs[r] + bounds[t][r];
+      sv[r] = vruns[r] + bounds[t][r] * pbytes;
+      sublen[r] = bounds[t + 1][r] - bounds[t][r];
+      range += sublen[r];
+    }
+    if (range > 0) {
+      uint64_t* o1 = out_k1 ? out_k1 + offset : nullptr;
+      uint16_t* o2 = out_k2 ? out_k2 + offset : nullptr;
+      uint8_t* ov = out_v + offset * pbytes;
+      ths.emplace_back([s1 = std::move(s1), s2 = std::move(s2),
+                        sv = std::move(sv), sublen = std::move(sublen), nruns,
+                        pbytes, o1, o2, ov]() mutable {
+        kway_merge_kv2(s1.data(), s2.data(), sv.data(), sublen.data(), nruns,
+                       pbytes, o1, o2, ov);
+      });
+    }
+    offset += range;
+  }
+  for (auto& th : ths) th.join();
+}
+
 // ---------------------------------------------------------------------------
 // Worker liveness table.
 // ---------------------------------------------------------------------------
@@ -362,6 +438,16 @@ void dsort_kway_merge_kv2_u64(const uint64_t** k1runs, const uint16_t** k2runs,
                               uint16_t* out_k2, uint8_t* out_v) {
   kway_merge_kv2(k1runs, k2runs, vruns, lens, nruns, pbytes, out_k1, out_k2,
                  out_v);
+}
+
+void dsort_kway_merge_kv2_par_u64(const uint64_t** k1runs,
+                                  const uint16_t** k2runs,
+                                  const uint8_t** vruns, const int64_t* lens,
+                                  int32_t nruns, int32_t pbytes,
+                                  uint64_t* out_k1, uint16_t* out_k2,
+                                  uint8_t* out_v, int32_t nthreads) {
+  kway_merge_kv2_parallel(k1runs, k2runs, vruns, lens, nruns, pbytes, out_k1,
+                          out_k2, out_v, nthreads);
 }
 
 void* dsort_table_create(int32_t n, double heartbeat_timeout_s) {

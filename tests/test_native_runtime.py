@@ -282,3 +282,28 @@ def test_native_parallel_merge_parity(dtype):
             np.testing.assert_array_equal(
                 native.kway_merge(runs, threads=th), expect
             )
+
+
+def test_native_parallel_kv2_merge_parity():
+    """Threaded record merge == serial == lexsort across thread counts."""
+    rng = np.random.default_rng(3)
+    k1s, k2s, vs = [], [], []
+    for n in (400_000, 0, 120_001):
+        k1 = rng.integers(0, 50, n).astype(np.uint64)  # heavy primary ties
+        k2 = rng.integers(0, 2**16, n).astype(np.uint16)
+        order = np.lexsort((k2, k1))
+        k1, k2 = k1[order], k2[order]
+        v = rng.integers(0, 256, (n, 20)).astype(np.uint8)
+        v[:, 0] = (k1 % 251).astype(np.uint8)
+        v[:, 1] = (k2 % 251).astype(np.uint8)
+        k1s.append(k1); k2s.append(k2); vs.append(v)
+    a1, a2 = np.concatenate(k1s), np.concatenate(k2s)
+    order = np.lexsort((a2, a1))
+    for th in (1, 5, 9):
+        ok1, ok2, ov = native.kway_merge_kv2(
+            k1s, k2s, vs, want_keys=True, threads=th
+        )
+        np.testing.assert_array_equal(ok1, a1[order])
+        np.testing.assert_array_equal(ok2, a2[order])
+        np.testing.assert_array_equal(ov[:, 0], (ok1 % 251).astype(np.uint8))
+        np.testing.assert_array_equal(ov[:, 1], (ok2 % 251).astype(np.uint8))
