@@ -153,3 +153,31 @@ def test_spmd_checkpointed_phase_recovery(mesh8, tmp_path):
     assert m.counters["mesh_reforms"] == 1
     # The retry found all runs checkpointed and restored them.
     assert m.counters["spmd_phase_restores"] >= 1
+
+
+def test_spmd_zipf_skew_with_injected_failure(mesh8):
+    """BASELINE config #5: Zipf-skewed keys AND a device failure in one job —
+    splitter quality under skew and reassign-on-failure compose."""
+    from dsort_tpu.data.ingest import gen_zipf
+
+    inj = FaultInjector()
+    inj.fail_once(5, "spmd")
+    sched = SpmdScheduler(job=FAST, injector=inj)
+    data = gen_zipf(60_000, a=1.2, seed=13)
+    m = Metrics()
+    out = sched.sort(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters["mesh_reforms"] == 1
+
+
+def test_taskpool_zipf_skew_with_kill():
+    from dsort_tpu.data.ingest import gen_zipf
+
+    inj = FaultInjector()
+    inj.kill(2)
+    sched = Scheduler(DeviceExecutor(injector=inj), FAST)
+    data = gen_zipf(60_000, a=1.3, seed=14)
+    m = Metrics()
+    out = sched.run_job(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters.get("reassignments", 0) >= 1
