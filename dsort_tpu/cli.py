@@ -199,6 +199,11 @@ def cmd_terasort(args) -> int:
     if args.external:
         from dsort_tpu.models.external_sort import ExternalTeraSort
 
+        if args.workers is not None:
+            log.warning(
+                "--workers has no effect with --external (run generation is "
+                "single-device; the merge parallelizes over host cores)"
+            )
         s = ExternalTeraSort(
             run_recs=args.run_recs,
             spill_dir=args.spill_dir,

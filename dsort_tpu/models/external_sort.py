@@ -260,11 +260,13 @@ class ExternalSort:
                 target = self._merge(runs, target, metrics)
             if fdt is not None:
                 if out is None:
-                    return ordered_uint_to_float(target, fdt)
+                    out = np.empty(n, dtype=fdt)
+                # Chunked unmap keeps the np.where temporaries O(run_elems)
+                # instead of 3x full-size.  Safe even when out aliases
+                # target (uint view of the same buffer): the RHS materializes
+                # before the slice assignment touches the shared bytes.
                 for lo in range(0, n, self.run_elems):
                     sl = slice(lo, min(lo + self.run_elems, n))
-                    # Safe in place: the RHS materializes (np.where output)
-                    # before the slice assignment touches the shared bytes.
                     out[sl] = ordered_uint_to_float(target[sl], fdt)
                 return out
             return target if out is None else out
@@ -385,16 +387,15 @@ class ExternalTeraSort:
 
     def _sort_run(self, recs: np.ndarray) -> np.ndarray:
         """Order one record slice by its full 10-byte key on device."""
-        from dsort_tpu.data.ingest import _pack_be64
+        from dsort_tpu.data.ingest import _pack_be64, terasort_secondary
 
         n = len(recs)
         if n != self.run_recs:  # final partial run: pad to the jitted shape
             pad = np.zeros((self.run_recs - n, self.RECORD_BYTES), np.uint8)
             recs = np.concatenate([recs, pad])
         k1 = _pack_be64(recs[:, :8])
-        k2 = ((recs[:, 8].astype(np.uint16) << np.uint16(8)) | recs[:, 9]).astype(
-            np.uint16
-        )
+        # recs[:, 8:] is exactly a TeraSort payload view (key bytes 8-9 first)
+        k2 = terasort_secondary(recs[:, 8:]).astype(np.uint16)
         out = np.asarray(
             self._sort_fn(jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(recs), n)
         )
@@ -442,7 +443,7 @@ class ExternalTeraSort:
         )
 
     def _merge_runs(self, runs, out, metrics: Metrics) -> None:
-        from dsort_tpu.data.ingest import _pack_be64
+        from dsort_tpu.data.ingest import _pack_be64, terasort_secondary
         from dsort_tpu.runtime import native
 
         if len(runs) == 1:
@@ -450,9 +451,7 @@ class ExternalTeraSort:
             return
         k1s = [_pack_be64(np.asarray(r[:, :8])) for r in runs]
         k2s = [
-            ((np.asarray(r[:, 8]).astype(np.uint16) << np.uint16(8)) | r[:, 9]).astype(
-                np.uint16
-            )
+            terasort_secondary(np.asarray(r[:, 8:10])).astype(np.uint16)
             for r in runs
         ]
         if native.available():

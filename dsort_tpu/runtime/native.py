@@ -227,6 +227,8 @@ def kway_merge(
     lib = _load()
     runs = [np.ascontiguousarray(r) for r in runs]
     dtype = runs[0].dtype
+    if dtype not in _MERGE_FNS:  # fail fast, before any output allocation
+        raise TypeError(f"native merge does not support {dtype}; see supports_dtype")
     total = sum(len(r) for r in runs)
     if out is None:
         out = np.empty(total, dtype=dtype)

@@ -109,13 +109,49 @@ void kway_merge_kv(const K** kruns, const uint8_t** vruns, const int64_t* lens,
   }
 }
 
-// Parallel k-way merge: range-partition the OUTPUT by key splitters, then
-// heap-merge each range on its own thread.  Splitter t is the median of the
-// runs' t/T-quantile elements — medians of coordinate-wise nondecreasing
-// vectors are nondecreasing, so range starts are monotone and every range
-// is a valid contiguous slice of each run (ties land left of the splitter
-// via lower_bound on every run consistently).  Balance is approximate
-// (exact balance is unnecessary for correctness or near-linear speedup).
+// Parallel range partitioning shared by the key-only and record merges:
+// range-partition the OUTPUT by key splitters, then hand each contiguous
+// range to `spawn_range`.  Splitter t is the median of the runs'
+// t/T-quantile keys — medians of coordinate-wise nondecreasing vectors are
+// nondecreasing, so range starts are monotone and every range is a valid
+// contiguous slice of each run (ties land left of the splitter via a
+// consistent lower_bound on every run).  Balance is approximate (exact
+// balance is unnecessary for correctness or near-linear speedup).
+//
+//   key_at(r, i) -> Key            the i-th key of run r
+//   lb(r, key) -> int64_t          lower_bound position of key in run r
+//   spawn_range(lo, hi, offset)    merge rows [lo[r], hi[r]) of every run
+//                                  into the output at element `offset`
+template <typename Key, typename KeyAt, typename LowerBound, typename Spawn>
+void parallel_range_partition(const int64_t* lens, int32_t nruns,
+                              int32_t nthreads, KeyAt key_at, LowerBound lb,
+                              Spawn spawn_range) {
+  // Boundary positions per (thread, run): bounds[t][r], plus the final end.
+  std::vector<std::vector<int64_t>> bounds(nthreads + 1,
+                                           std::vector<int64_t>(nruns, 0));
+  for (int32_t r = 0; r < nruns; ++r) bounds[nthreads][r] = lens[r];
+  for (int32_t t = 1; t < nthreads; ++t) {
+    std::vector<Key> cands;
+    cands.reserve(nruns);
+    for (int32_t r = 0; r < nruns; ++r) {
+      if (lens[r] > 0) cands.push_back(key_at(r, lens[r] * t / nthreads));
+    }
+    if (cands.empty()) continue;
+    std::nth_element(cands.begin(), cands.begin() + cands.size() / 2,
+                     cands.end());
+    Key split = cands[cands.size() / 2];
+    for (int32_t r = 0; r < nruns; ++r) bounds[t][r] = lb(r, split);
+  }
+  int64_t offset = 0;
+  for (int32_t t = 0; t < nthreads; ++t) {
+    int64_t range = 0;
+    for (int32_t r = 0; r < nruns; ++r)
+      range += bounds[t + 1][r] - bounds[t][r];
+    if (range > 0) spawn_range(bounds[t], bounds[t + 1], offset);
+    offset += range;
+  }
+}
+
 template <typename K>
 void kway_merge_parallel(const K** runs, const int64_t* lens, int32_t nruns,
                          K* out, int32_t nthreads) {
@@ -125,45 +161,27 @@ void kway_merge_parallel(const K** runs, const int64_t* lens, int32_t nruns,
     kway_merge<K>(runs, lens, nruns, out);
     return;
   }
-  // Boundary positions per (thread, run): bounds[t][r], plus the final end.
-  std::vector<std::vector<int64_t>> bounds(nthreads + 1,
-                                           std::vector<int64_t>(nruns, 0));
-  for (int32_t r = 0; r < nruns; ++r) bounds[nthreads][r] = lens[r];
-  for (int32_t t = 1; t < nthreads; ++t) {
-    std::vector<K> cands;
-    cands.reserve(nruns);
-    for (int32_t r = 0; r < nruns; ++r) {
-      if (lens[r] > 0) cands.push_back(runs[r][lens[r] * t / nthreads]);
-    }
-    if (cands.empty()) continue;
-    std::nth_element(cands.begin(), cands.begin() + cands.size() / 2,
-                     cands.end());
-    K split = cands[cands.size() / 2];
-    for (int32_t r = 0; r < nruns; ++r) {
-      bounds[t][r] =
-          std::lower_bound(runs[r], runs[r] + lens[r], split) - runs[r];
-    }
-  }
   std::vector<std::thread> ths;
-  int64_t offset = 0;
-  for (int32_t t = 0; t < nthreads; ++t) {
-    std::vector<const K*> sub(nruns);
-    std::vector<int64_t> sublen(nruns);
-    int64_t range = 0;
-    for (int32_t r = 0; r < nruns; ++r) {
-      sub[r] = runs[r] + bounds[t][r];
-      sublen[r] = bounds[t + 1][r] - bounds[t][r];
-      range += sublen[r];
-    }
-    if (range > 0) {
-      ths.emplace_back(
-          [sub = std::move(sub), sublen = std::move(sublen), nruns,
-           dst = out + offset]() mutable {
-            kway_merge<K>(sub.data(), sublen.data(), nruns, dst);
-          });
-    }
-    offset += range;
-  }
+  parallel_range_partition<K>(
+      lens, nruns, nthreads,
+      [&](int32_t r, int64_t i) { return runs[r][i]; },
+      [&](int32_t r, K key) {
+        return std::lower_bound(runs[r], runs[r] + lens[r], key) - runs[r];
+      },
+      [&](const std::vector<int64_t>& lo, const std::vector<int64_t>& hi,
+          int64_t offset) {
+        std::vector<const K*> sub(nruns);
+        std::vector<int64_t> sublen(nruns);
+        for (int32_t r = 0; r < nruns; ++r) {
+          sub[r] = runs[r] + lo[r];
+          sublen[r] = hi[r] - lo[r];
+        }
+        ths.emplace_back(
+            [sub = std::move(sub), sublen = std::move(sublen), nruns,
+             dst = out + offset]() mutable {
+              kway_merge<K>(sub.data(), sublen.data(), nruns, dst);
+            });
+      });
   for (auto& th : ths) th.join();
 }
 
@@ -217,8 +235,8 @@ int64_t lower_bound_pair(const uint64_t* k1, const uint16_t* k2, int64_t len,
   return lo;
 }
 
-// Threaded variant of the record merge: same output range partitioning as
-// kway_merge_parallel, with splitters and boundaries on the (k1, k2) pair.
+// Threaded variant of the record merge: the shared range partitioning with
+// splitters and boundaries on the (k1, k2) pair.
 void kway_merge_kv2_parallel(const uint64_t** k1runs, const uint16_t** k2runs,
                              const uint8_t** vruns, const int64_t* lens,
                              int32_t nruns, int32_t pbytes, uint64_t* out_k1,
@@ -226,59 +244,42 @@ void kway_merge_kv2_parallel(const uint64_t** k1runs, const uint16_t** k2runs,
                              int32_t nthreads) {
   int64_t total = 0;
   for (int32_t r = 0; r < nruns; ++r) total += lens[r];
-  if (nthreads <= 1 || total < (1 << 18) || nruns < 2) {
+  if (nthreads <= 1 || total < (1 << 20) || nruns < 2) {
     kway_merge_kv2(k1runs, k2runs, vruns, lens, nruns, pbytes, out_k1, out_k2,
                    out_v);
     return;
   }
-  std::vector<std::vector<int64_t>> bounds(nthreads + 1,
-                                           std::vector<int64_t>(nruns, 0));
-  for (int32_t r = 0; r < nruns; ++r) bounds[nthreads][r] = lens[r];
-  for (int32_t t = 1; t < nthreads; ++t) {
-    std::vector<Key2> cands;
-    cands.reserve(nruns);
-    for (int32_t r = 0; r < nruns; ++r) {
-      if (lens[r] > 0) {
-        int64_t q = lens[r] * t / nthreads;
-        cands.push_back({k1runs[r][q], k2runs[r][q]});
-      }
-    }
-    if (cands.empty()) continue;
-    std::nth_element(cands.begin(), cands.begin() + cands.size() / 2,
-                     cands.end());
-    Key2 split = cands[cands.size() / 2];
-    for (int32_t r = 0; r < nruns; ++r) {
-      bounds[t][r] = lower_bound_pair(k1runs[r], k2runs[r], lens[r], split);
-    }
-  }
   std::vector<std::thread> ths;
-  int64_t offset = 0;
-  for (int32_t t = 0; t < nthreads; ++t) {
-    std::vector<const uint64_t*> s1(nruns);
-    std::vector<const uint16_t*> s2(nruns);
-    std::vector<const uint8_t*> sv(nruns);
-    std::vector<int64_t> sublen(nruns);
-    int64_t range = 0;
-    for (int32_t r = 0; r < nruns; ++r) {
-      s1[r] = k1runs[r] + bounds[t][r];
-      s2[r] = k2runs[r] + bounds[t][r];
-      sv[r] = vruns[r] + bounds[t][r] * pbytes;
-      sublen[r] = bounds[t + 1][r] - bounds[t][r];
-      range += sublen[r];
-    }
-    if (range > 0) {
-      uint64_t* o1 = out_k1 ? out_k1 + offset : nullptr;
-      uint16_t* o2 = out_k2 ? out_k2 + offset : nullptr;
-      uint8_t* ov = out_v + offset * pbytes;
-      ths.emplace_back([s1 = std::move(s1), s2 = std::move(s2),
-                        sv = std::move(sv), sublen = std::move(sublen), nruns,
-                        pbytes, o1, o2, ov]() mutable {
-        kway_merge_kv2(s1.data(), s2.data(), sv.data(), sublen.data(), nruns,
-                       pbytes, o1, o2, ov);
+  parallel_range_partition<Key2>(
+      lens, nruns, nthreads,
+      [&](int32_t r, int64_t i) {
+        return Key2{k1runs[r][i], k2runs[r][i]};
+      },
+      [&](int32_t r, Key2 key) {
+        return lower_bound_pair(k1runs[r], k2runs[r], lens[r], key);
+      },
+      [&](const std::vector<int64_t>& lo, const std::vector<int64_t>& hi,
+          int64_t offset) {
+        std::vector<const uint64_t*> s1(nruns);
+        std::vector<const uint16_t*> s2(nruns);
+        std::vector<const uint8_t*> sv(nruns);
+        std::vector<int64_t> sublen(nruns);
+        for (int32_t r = 0; r < nruns; ++r) {
+          s1[r] = k1runs[r] + lo[r];
+          s2[r] = k2runs[r] + lo[r];
+          sv[r] = vruns[r] + lo[r] * pbytes;
+          sublen[r] = hi[r] - lo[r];
+        }
+        uint64_t* o1 = out_k1 ? out_k1 + offset : nullptr;
+        uint16_t* o2 = out_k2 ? out_k2 + offset : nullptr;
+        uint8_t* ov = out_v + offset * pbytes;
+        ths.emplace_back([s1 = std::move(s1), s2 = std::move(s2),
+                          sv = std::move(sv), sublen = std::move(sublen),
+                          nruns, pbytes, o1, o2, ov]() mutable {
+          kway_merge_kv2(s1.data(), s2.data(), sv.data(), sublen.data(),
+                         nruns, pbytes, o1, o2, ov);
+        });
       });
-    }
-    offset += range;
-  }
   for (auto& th : ths) th.join();
 }
 

@@ -29,12 +29,19 @@ def annotate(name: str):
     Only the annotation SETUP is guarded — exceptions raised by the body
     must propagate (a fault-tolerance path relies on JobFailedError crossing
     phase boundaries), so no try/except may wrap the ``yield``.
-    """
-    try:
-        import jax
 
-        cm = jax.profiler.TraceAnnotation(name)
-    except Exception:
-        cm = contextlib.nullcontext()
+    If jax is not already imported, nothing can be profiling this process —
+    so don't trigger the multi-second jax import from jax-free processes
+    (e.g. a numpy-backend coordinator) just to build a no-op annotation.
+    """
+    import sys
+
+    cm = contextlib.nullcontext()
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            cm = jax_mod.profiler.TraceAnnotation(name)
+        except Exception:
+            pass
     with cm:
         yield

@@ -43,9 +43,15 @@ def _run_cluster(tmp_path, dtype: str) -> None:
         )
         for pid in range(2)
     ]
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, err.decode()[-2000:]
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()[-2000:]
+    finally:  # a hung cluster must not leak live jax processes into CI
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
 
 
 def _check(tmp_path, sort_like_numpy) -> None:
