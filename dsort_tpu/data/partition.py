@@ -75,11 +75,22 @@ def pad_to_layout(
 
 
 def pad_kv_to_shards(
-    keys: np.ndarray, payload: np.ndarray, num_workers: int, multiple: int = 8
+    keys: np.ndarray,
+    payload: np.ndarray,
+    num_workers: int,
+    multiple: int = 8,
+    cap: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Key+payload variant of `pad_to_shards`; payload pads are zeros."""
+    """Key+payload variant of `pad_to_shards`; payload pads are zeros.
+
+    Like `pad_to_shards`, an explicit ``cap`` lets multi-host drivers agree
+    on one global layout across hosts with unequal record counts.
+    """
     sizes = equal_partition(len(keys), num_workers)
-    cap = -(-max(sizes + [1]) // multiple) * multiple
+    if cap is None:
+        cap = -(-max(sizes + [1]) // multiple) * multiple
+    elif cap < max(sizes + [0]):
+        raise ValueError(f"cap {cap} < largest shard {max(sizes)}")
     out_k = np.full((num_workers, cap), sentinel_for(keys.dtype), dtype=keys.dtype)
     out_v = np.zeros((num_workers, cap) + payload.shape[1:], dtype=payload.dtype)
     off = 0

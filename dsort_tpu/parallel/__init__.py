@@ -2,6 +2,7 @@
 
 from dsort_tpu.parallel.distributed import (  # noqa: F401
     initialize_multihost,
+    sort_local_records,
     sort_local_shards,
 )
 from dsort_tpu.parallel.mesh import make_mesh, local_device_mesh  # noqa: F401

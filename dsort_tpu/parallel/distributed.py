@@ -172,3 +172,133 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
     flat_counts = np.asarray(all_counts).reshape(-1)
     offset = int(flat_counts[:first_dev].sum())
     return local_sorted, offset
+
+
+def sort_local_records(
+    keys,
+    payload,
+    secondary=None,
+    job=None,
+    axis_name: str = "w",
+    metrics=None,
+):
+    """Pod-wide key+payload (TeraSort) sort with per-host ingest/egress.
+
+    The record twin of `sort_local_shards`: every process contributes its
+    host-local ``(keys, payload[, secondary])``, the kv shuffle runs over
+    the global mesh (``_sample_sort_kv2_shard`` when a secondary tiebreak
+    rides along, else the plain kv shard), and each process gets back
+    ``(keys_slice, payload_slice, global_offset)`` — its devices' contiguous
+    portion of the globally ordered records.  All processes must make
+    identical calls.
+    """
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.partition import pad_kv_to_shards, pad_to_layout
+    from dsort_tpu.ops.float_order import (
+        is_float_key_dtype,
+        sort_float_keys_via_uint,
+    )
+    from dsort_tpu.parallel.sample_sort import (
+        _sample_sort_kv2_shard,
+        _sample_sort_kv_shard,
+    )
+    from dsort_tpu.utils.metrics import Metrics
+
+    keys = np.asarray(keys)
+    payload = np.asarray(payload)
+    if is_float_key_dtype(keys.dtype):
+        return sort_float_keys_via_uint(
+            sort_local_records, keys, payload, secondary, job, axis_name, metrics
+        )
+    job = job or JobConfig()
+    metrics = metrics if metrics is not None else Metrics()
+    mesh = global_worker_mesh(axis_name)
+    p_total = int(mesh.shape[axis_name])
+    n_local_devices = len(jax.local_devices())
+
+    my_cap = -(-max(len(keys), 1) // (8 * n_local_devices)) * 8
+    caps = multihost_utils.process_allgather(np.asarray([my_cap], np.int64))
+    cap = int(np.max(caps))
+    sk, sv, counts = pad_kv_to_shards(keys, payload, n_local_devices, cap=cap)
+
+    sharding = NamedSharding(mesh, P(axis_name))
+    xs = jax.make_array_from_process_local_data(sharding, sk.reshape(-1))
+    vs = jax.make_array_from_process_local_data(
+        sharding, sv.reshape((-1,) + sv.shape[2:])
+    )
+    cj = jax.make_array_from_process_local_data(sharding, counts)
+    if secondary is not None:
+        ss = pad_to_layout(np.asarray(secondary), counts, cap)
+        sj = jax.make_array_from_process_local_data(sharding, ss.reshape(-1))
+
+    replicated = NamedSharding(mesh, P())
+    any_overflow = jax.jit(jnp.any, out_shardings=replicated)
+    factor = job.capacity_factor
+    for _ in range(job.max_capacity_retries + 1):
+        cap_pair = max(-(-int(np.ceil(factor * cap / p_total)) // 8) * 8, 8)
+        kwargs = dict(
+            num_workers=p_total,
+            oversample=job.oversample,
+            cap_pair=cap_pair,
+            axis=axis_name,
+            merge_kernel=job.merge_kernel,
+        )
+        if secondary is not None:
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(_sample_sort_kv2_shard, **kwargs),
+                    mesh=mesh,
+                    in_specs=(P(axis_name),) * 4,
+                    out_specs=(P(axis_name),) * 5,
+                    check_vma=False,
+                )
+            )
+            out_k, _, out_v, out_counts, overflow = fn(xs, sj, vs, cj)
+        else:
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(_sample_sort_kv_shard, **kwargs),
+                    mesh=mesh,
+                    in_specs=(P(axis_name),) * 3,
+                    out_specs=(P(axis_name),) * 4,
+                    check_vma=False,
+                )
+            )
+            out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
+        if not bool(any_overflow(overflow)):
+            break
+        metrics.bump("capacity_retries")
+        factor *= 2.0
+        log.warning("multihost kv overflow: retrying with factor=%.1f", factor)
+    else:
+        raise RuntimeError("sample sort bucket overflow after max retries")
+
+    def _local_shards(garr):
+        rows = sorted(garr.addressable_shards, key=lambda s: s.index[0].start)
+        return [np.asarray(s.data) for s in rows], rows[0].index[0].start
+
+    count_rows, _ = _local_shards(out_counts)
+    k_rows, k_start = _local_shards(out_k)
+    v_rows, _ = _local_shards(out_v)
+    local_counts = np.concatenate([r.reshape(-1) for r in count_rows])
+    local_k = np.concatenate(
+        [r.reshape(-1)[: int(c)] for r, c in zip(k_rows, local_counts)]
+    )
+    local_v = np.concatenate(
+        [
+            r.reshape((-1,) + sv.shape[2:])[: int(c)]
+            for r, c in zip(v_rows, local_counts)
+        ]
+    )
+    all_counts = multihost_utils.process_allgather(local_counts)
+    per_dev = k_rows[0].reshape(-1).shape[0]
+    first_dev = k_start // per_dev if per_dev else 0
+    offset = int(np.asarray(all_counts).reshape(-1)[:first_dev].sum())
+    return local_k, local_v, offset

@@ -29,10 +29,29 @@ def main() -> None:
 
     import numpy as np
 
-    from dsort_tpu.parallel.distributed import sort_local_shards
-
     rng = np.random.default_rng(100 + pid)
     n = 4000 + 1000 * pid  # deliberately unequal host loads
+
+    if dtype == "terasort":
+        from dsort_tpu.config import JobConfig
+        from dsort_tpu.data.ingest import gen_terasort, terasort_secondary
+        from dsort_tpu.parallel.distributed import sort_local_records
+
+        keys, payload = gen_terasort(n, seed=100 + pid)
+        job = JobConfig(key_dtype=np.uint64, payload_bytes=payload.shape[1])
+        out_k, out_v, off = sort_local_records(
+            keys, payload, secondary=terasort_secondary(payload), job=job
+        )
+        np.save(os.path.join(outdir, f"in_{pid}.npy"), keys)
+        np.save(os.path.join(outdir, f"inv_{pid}.npy"), payload)
+        np.save(os.path.join(outdir, f"out_{pid}.npy"), out_k)
+        np.save(os.path.join(outdir, f"outv_{pid}.npy"), out_v)
+        with open(os.path.join(outdir, f"meta_{pid}.json"), "w") as f:
+            json.dump({"offset": off}, f)
+        return
+
+    from dsort_tpu.parallel.distributed import sort_local_shards
+
     if dtype == "float32nan":
         data = rng.normal(size=n).astype(np.float32)
         data[::97] = np.nan

@@ -76,6 +76,27 @@ def test_two_process_cluster_int32(tmp_path):
     )
 
 
+def test_two_process_cluster_terasort_records(tmp_path):
+    """TeraSort records (two-level key + 92 B payload) across the 2-process
+    cluster: each host feeds local records, gets back its key-range slice."""
+    from dsort_tpu.data.ingest import terasort_secondary
+
+    _run_cluster(tmp_path, "terasort")
+    kin = [np.load(tmp_path / f"in_{i}.npy") for i in range(2)]
+    vin = [np.load(tmp_path / f"inv_{i}.npy") for i in range(2)]
+    kout = [np.load(tmp_path / f"out_{i}.npy") for i in range(2)]
+    vout = [np.load(tmp_path / f"outv_{i}.npy") for i in range(2)]
+    offs = [
+        json.load(open(tmp_path / f"meta_{i}.json"))["offset"] for i in range(2)
+    ]
+    all_k, all_v = np.concatenate(kin), np.concatenate(vin)
+    got_k, got_v = np.concatenate(kout), np.concatenate(vout)
+    assert offs[0] == 0 and offs[1] == len(kout[0])
+    order = np.lexsort((terasort_secondary(all_v), all_k))
+    np.testing.assert_array_equal(got_k, all_k[order])
+    np.testing.assert_array_equal(got_v, all_v[order])
+
+
 def test_two_process_cluster_float32_nan(tmp_path):
     """NaN float keys survive the multi-host path too (boundary bijection)."""
     _run_cluster(tmp_path, "float32nan")
