@@ -184,3 +184,15 @@ def test_sort_kv_float_keys_nan(mesh8):
     k = (~np.isnan(keys)).sum()
     np.testing.assert_array_equal(sk[:k], keys[order][:k])
     assert set(sv[k:].tolist()) == nan_payloads
+
+
+@pytest.mark.parametrize("dtype,udtype", [(np.float32, np.uint32), (np.float64, np.uint64)])
+def test_bijection_fuzz_random_bit_patterns(dtype, udtype):
+    """Every bit pattern is legal input: denormals, both NaN signs, all NaN
+    payloads, infinities.  Sorting the mapped uints must equal np.sort on
+    the non-NaN part with all NaNs (canonicalized) at the tail."""
+    rng = np.random.default_rng(99)
+    bits = rng.integers(0, np.iinfo(udtype).max, 20_000, dtype=udtype)
+    x = bits.view(dtype)
+    got = ordered_uint_to_float(np.sort(float_to_ordered_uint(x)), dtype)
+    _check_sorted_like_numpy(got, x)
