@@ -13,6 +13,7 @@ a no-op passthrough, so the same code path serves laptop → pod.
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
@@ -68,6 +69,52 @@ def global_worker_mesh(axis_name: str = "w"):
     return Mesh(np.array(jax.devices()), (axis_name,))
 
 
+@functools.lru_cache(maxsize=64)
+def _build_mh_program(
+    mesh, axis_name, p_total, cap_pair, oversample, kernel, merge_kernel, mode
+):
+    """jit(shard_map(...)) for one multihost program shape, cached.
+
+    ``functools.partial`` objects never compare equal, so building the
+    program inline would defeat jax's jit cache and re-trace EVERY job;
+    this mirrors `SampleSort._build`'s lru_cache.  jax Meshes hash by
+    device assignment + axis names, so the cache key is exact.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dsort_tpu.parallel.sample_sort import (
+        _sample_sort_kv2_shard,
+        _sample_sort_kv_shard,
+        _sample_sort_shard,
+    )
+
+    kw = dict(
+        num_workers=p_total,
+        oversample=oversample,
+        cap_pair=cap_pair,
+        axis=axis_name,
+        merge_kernel=merge_kernel,
+    )
+    if mode == "keys":
+        fn = functools.partial(_sample_sort_shard, kernel=kernel, **kw)
+        n_in, n_out = 2, 3
+    elif mode == "kv":
+        fn = functools.partial(_sample_sort_kv_shard, **kw)
+        n_in, n_out = 3, 4
+    else:  # kv2
+        fn = functools.partial(_sample_sort_kv2_shard, **kw)
+        n_in, n_out = 4, 5
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(axis_name),) * n_in,
+            out_specs=(P(axis_name),) * n_out,
+            check_vma=False,
+        )
+    )
+
+
 def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
     """Pod-wide sort with per-host ingest/egress (call from EVERY process).
 
@@ -119,31 +166,14 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
     xs = jax.make_array_from_process_local_data(sharding, shards.reshape(-1))
     cj = jax.make_array_from_process_local_data(sharding, counts)
 
-    import functools
-
-    from dsort_tpu.parallel.sample_sort import _sample_sort_shard
-
     replicated = NamedSharding(mesh, P())
     any_overflow = jax.jit(jnp.any, out_shardings=replicated)
     factor = job.capacity_factor
     for _ in range(job.max_capacity_retries + 1):
         cap_pair = max(-(-int(np.ceil(factor * cap / p_total)) // 8) * 8, 8)
-        fn = jax.jit(
-            jax.shard_map(
-                functools.partial(
-                    _sample_sort_shard,
-                    num_workers=p_total,
-                    oversample=job.oversample,
-                    cap_pair=cap_pair,
-                    axis=axis_name,
-                    kernel=job.local_kernel,
-                    merge_kernel=job.merge_kernel,
-                ),
-                mesh=mesh,
-                in_specs=(P(axis_name), P(axis_name)),
-                out_specs=(P(axis_name), P(axis_name), P(axis_name)),
-                check_vma=False,
-            )
+        fn = _build_mh_program(
+            mesh, axis_name, p_total, cap_pair, job.oversample,
+            job.local_kernel, job.merge_kernel, "keys",
         )
         merged, out_counts, overflow = fn(xs, cj)
         if not bool(any_overflow(overflow)):  # replicated: consistent everywhere
@@ -192,8 +222,6 @@ def sort_local_records(
     portion of the globally ordered records.  All processes must make
     identical calls.
     """
-    import functools
-
     import jax.numpy as jnp
     import numpy as np
     from jax.experimental import multihost_utils
@@ -243,34 +271,14 @@ def sort_local_records(
     factor = job.capacity_factor
     for _ in range(job.max_capacity_retries + 1):
         cap_pair = max(-(-int(np.ceil(factor * cap / p_total)) // 8) * 8, 8)
-        kwargs = dict(
-            num_workers=p_total,
-            oversample=job.oversample,
-            cap_pair=cap_pair,
-            axis=axis_name,
-            merge_kernel=job.merge_kernel,
+        fn = _build_mh_program(
+            mesh, axis_name, p_total, cap_pair, job.oversample,
+            job.local_kernel, job.merge_kernel,
+            "kv2" if secondary is not None else "kv",
         )
         if secondary is not None:
-            fn = jax.jit(
-                jax.shard_map(
-                    functools.partial(_sample_sort_kv2_shard, **kwargs),
-                    mesh=mesh,
-                    in_specs=(P(axis_name),) * 4,
-                    out_specs=(P(axis_name),) * 5,
-                    check_vma=False,
-                )
-            )
             out_k, _, out_v, out_counts, overflow = fn(xs, sj, vs, cj)
         else:
-            fn = jax.jit(
-                jax.shard_map(
-                    functools.partial(_sample_sort_kv_shard, **kwargs),
-                    mesh=mesh,
-                    in_specs=(P(axis_name),) * 3,
-                    out_specs=(P(axis_name),) * 4,
-                    check_vma=False,
-                )
-            )
             out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
         if not bool(any_overflow(overflow)):
             break
