@@ -135,9 +135,76 @@ def cmd_serve(args) -> int:
             log.error("job failed: %s", e)
 
 
+_REF_KEYS_PER_SEC = 16_384 / 0.374  # BASELINE.md measured reference throughput
+
+
+def _bench_suite(args) -> int:
+    """The BASELINE config ladder, one JSON line per config.
+
+    1. the reference's own workload (its 16,384-key maximum, ``server.c:13``)
+    2. 1M uniform int32, SPMD sample sort over the local mesh
+    3. 1M uniform int64 (needs x64; cli.main enabled it)
+    4. TeraSort records (full 10-byte key + 90 B payload), kv shuffle
+    5. 1M Zipf-skewed keys WITH one injected worker failure
+    """
+    import jax
+
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.ingest import gen_terasort, gen_uniform, gen_zipf, terasort_secondary
+    from dsort_tpu.parallel.mesh import local_device_mesh
+    from dsort_tpu.parallel.sample_sort import SampleSort
+    from dsort_tpu.scheduler import FaultInjector, SpmdScheduler
+
+    mesh = local_device_mesh()
+    reps = args.reps
+
+    def timed(label, n, unit, fn):
+        fn()  # warm/compile
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        dt = float(np.median(times))
+        print(json.dumps({
+            "metric": label,
+            "value": round(n / dt, 1),
+            "unit": unit,
+            "vs_baseline": round(n / dt / _REF_KEYS_PER_SEC, 2),
+        }))
+
+    ss32 = SampleSort(mesh)
+    ref = gen_uniform(16_384, seed=0)
+    timed("config1_reference_workload_16384_int32", len(ref), "keys/sec",
+          lambda: ss32.sort(ref))
+    u32 = gen_uniform(1 << 20, seed=1)
+    timed("config2_uniform_1M_int32_spmd", len(u32), "keys/sec",
+          lambda: ss32.sort(u32))
+    u64 = gen_uniform(1 << 20, dtype=np.int64, seed=2)
+    ss64 = SampleSort(mesh, JobConfig(key_dtype=np.int64))
+    timed("config3_uniform_1M_int64_spmd", len(u64), "keys/sec",
+          lambda: ss64.sort(u64))
+    tk, tv = gen_terasort(1 << 16, seed=3)
+    tsec = terasort_secondary(tv)
+    sst = SampleSort(mesh, JobConfig(key_dtype=np.uint64, payload_bytes=tv.shape[1]))
+    timed("config4_terasort_65536_records_kv", len(tk), "rec/sec",
+          lambda: sst.sort_kv(tk, tv, secondary=tsec))
+    z = gen_zipf(1 << 20, a=1.3, seed=4)
+
+    def faulted():
+        inj = FaultInjector()
+        inj.fail_once(2, "spmd")
+        SpmdScheduler(job=JobConfig(settle_delay_s=0.01), injector=inj).sort(z)
+
+    timed("config5_zipf_1M_with_injected_failure", len(z), "keys/sec", faulted)
+    return 0
+
+
 def cmd_bench(args) -> int:
     from dsort_tpu.data.ingest import gen_uniform
 
+    if args.suite:
+        return _bench_suite(args)
     cfg = _load_config(args)
     sorter = _make_sorter(cfg, args.mode)
     data = gen_uniform(args.n, dtype=np.dtype(cfg.job.key_dtype), seed=0)
@@ -372,6 +439,8 @@ def main(argv=None) -> int:
     common(p)
     p.add_argument("--n", type=int, default=1 << 22)
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--suite", action="store_true",
+                   help="run the BASELINE config ladder (one JSON line each)")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser("gen", help="generate synthetic input files")

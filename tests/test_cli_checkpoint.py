@@ -1,5 +1,7 @@
 """CLI + checkpoint/recovery tests."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -168,3 +170,24 @@ def test_cli_subprocess_enables_x64(tmp_path):
     np.testing.assert_array_equal(
         np.fromfile(out, dtype=np.int64), np.sort(data)
     )
+
+
+def test_cli_bench_suite_runs_all_configs():
+    """The BASELINE config ladder emits one valid JSON line per config."""
+    import json as _json
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    r = subprocess.run(
+        [sys.executable, "-m", "dsort_tpu.cli", "bench", "--suite", "--reps", "1"],
+        env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 5
+    metrics = [_json.loads(l) for l in lines]
+    assert [m["metric"][:7] for m in metrics] == [
+        f"config{i}" for i in range(1, 6)
+    ]
+    assert all(m["value"] > 0 and m["vs_baseline"] > 1 for m in metrics)
