@@ -142,7 +142,7 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
         is_float_key_dtype,
         sort_float_keys_via_uint,
     )
-    from dsort_tpu.utils.metrics import Metrics
+    from dsort_tpu.utils.metrics import Metrics, PhaseTimer
 
     local_data = np.asarray(local_data)
     if is_float_key_dtype(local_data.dtype):
@@ -152,19 +152,21 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
         return out, off
     job = job or JobConfig()
     metrics = metrics if metrics is not None else Metrics()
+    timer = PhaseTimer(metrics)
     mesh = global_worker_mesh(axis_name)
     p_total = int(mesh.shape[axis_name])
     n_local_devices = len(jax.local_devices())
 
-    # Hosts may hold unequal amounts; agree on one global per-device cap.
-    my_cap = -(-max(len(local_data), 1) // (8 * n_local_devices)) * 8
-    caps = multihost_utils.process_allgather(np.asarray([my_cap], np.int64))
-    cap = int(np.max(caps))
-    shards, counts = pad_to_shards(local_data, n_local_devices, cap=cap)
+    with timer.phase("partition"):
+        # Hosts may hold unequal amounts; agree on one global per-device cap.
+        my_cap = -(-max(len(local_data), 1) // (8 * n_local_devices)) * 8
+        caps = multihost_utils.process_allgather(np.asarray([my_cap], np.int64))
+        cap = int(np.max(caps))
+        shards, counts = pad_to_shards(local_data, n_local_devices, cap=cap)
 
-    sharding = NamedSharding(mesh, P(axis_name))
-    xs = jax.make_array_from_process_local_data(sharding, shards.reshape(-1))
-    cj = jax.make_array_from_process_local_data(sharding, counts)
+        sharding = NamedSharding(mesh, P(axis_name))
+        xs = jax.make_array_from_process_local_data(sharding, shards.reshape(-1))
+        cj = jax.make_array_from_process_local_data(sharding, counts)
 
     replicated = NamedSharding(mesh, P())
     any_overflow = jax.jit(jnp.any, out_shardings=replicated)
@@ -175,8 +177,10 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
             mesh, axis_name, p_total, cap_pair, job.oversample,
             job.local_kernel, job.merge_kernel, "keys",
         )
-        merged, out_counts, overflow = fn(xs, cj)
-        if not bool(any_overflow(overflow)):  # replicated: consistent everywhere
+        with timer.phase("spmd_sort"):
+            merged, out_counts, overflow = fn(xs, cj)
+            ok = not bool(any_overflow(overflow))  # replicated: consistent
+        if ok:
             break
         metrics.bump("capacity_retries")
         factor *= 2.0
@@ -190,17 +194,20 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
         rows = sorted(garr.addressable_shards, key=lambda s: s.index[0].start)
         return [np.asarray(s.data).reshape(-1) for s in rows], rows[0].index[0].start
 
-    count_rows, _ = _local_rows(out_counts)
-    merged_rows, merged_start = _local_rows(merged)
-    local_counts = np.concatenate(count_rows)
-    local_sorted = np.concatenate(
-        [r[: int(c)] for r, c in zip(merged_rows, local_counts)]
-    )
-    # Global offset of this host's slice = total valid keys on earlier devices.
-    all_counts = multihost_utils.process_allgather(local_counts)
-    first_dev = merged_start // merged_rows[0].shape[0] if merged_rows[0].size else 0
-    flat_counts = np.asarray(all_counts).reshape(-1)
-    offset = int(flat_counts[:first_dev].sum())
+    with timer.phase("assemble"):
+        count_rows, _ = _local_rows(out_counts)
+        merged_rows, merged_start = _local_rows(merged)
+        local_counts = np.concatenate(count_rows)
+        local_sorted = np.concatenate(
+            [r[: int(c)] for r, c in zip(merged_rows, local_counts)]
+        )
+        # Global offset of this host's slice = valid keys on earlier devices.
+        all_counts = multihost_utils.process_allgather(local_counts)
+        first_dev = (
+            merged_start // merged_rows[0].shape[0] if merged_rows[0].size else 0
+        )
+        flat_counts = np.asarray(all_counts).reshape(-1)
+        offset = int(flat_counts[:first_dev].sum())
     return local_sorted, offset
 
 
@@ -237,7 +244,7 @@ def sort_local_records(
         _sample_sort_kv2_shard,
         _sample_sort_kv_shard,
     )
-    from dsort_tpu.utils.metrics import Metrics
+    from dsort_tpu.utils.metrics import Metrics, PhaseTimer
 
     keys = np.asarray(keys)
     payload = np.asarray(payload)
@@ -247,6 +254,7 @@ def sort_local_records(
         )
     job = job or JobConfig()
     metrics = metrics if metrics is not None else Metrics()
+    timer = PhaseTimer(metrics)
     mesh = global_worker_mesh(axis_name)
     p_total = int(mesh.shape[axis_name])
     n_local_devices = len(jax.local_devices())
@@ -276,11 +284,13 @@ def sort_local_records(
             job.local_kernel, job.merge_kernel,
             "kv2" if secondary is not None else "kv",
         )
-        if secondary is not None:
-            out_k, _, out_v, out_counts, overflow = fn(xs, sj, vs, cj)
-        else:
-            out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
-        if not bool(any_overflow(overflow)):
+        with timer.phase("spmd_sort"):
+            if secondary is not None:
+                out_k, _, out_v, out_counts, overflow = fn(xs, sj, vs, cj)
+            else:
+                out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
+            ok = not bool(any_overflow(overflow))
+        if ok:
             break
         metrics.bump("capacity_retries")
         factor *= 2.0
