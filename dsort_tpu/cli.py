@@ -190,13 +190,29 @@ def _bench_suite(args) -> int:
     timed("config4_terasort_65536_records_kv", len(tk), "rec/sec",
           lambda: sst.sort_kv(tk, tv, secondary=tsec))
     z = gen_zipf(1 << 20, a=1.3, seed=4)
-
-    def faulted():
+    if len(jax.devices()) >= 4:
+        # One scheduler reused across reps (its per-device-set SampleSort
+        # cache keeps the SPMD programs compiled); the injector re-arms each
+        # call so EVERY rep really recovers from a failure — verified below.
         inj = FaultInjector()
-        inj.fail_once(2, "spmd")
-        SpmdScheduler(job=JobConfig(settle_delay_s=0.01), injector=inj).sort(z)
+        sched = SpmdScheduler(job=JobConfig(settle_delay_s=0.01), injector=inj)
 
-    timed("config5_zipf_1M_with_injected_failure", len(z), "keys/sec", faulted)
+        def faulted():
+            inj.fail_once(2, "spmd")
+            m = Metrics()
+            sched.sort(z, metrics=m)
+            if not m.counters.get("mesh_reforms"):
+                raise RuntimeError("config5: injected failure did not fire")
+
+        timed("config5_zipf_1M_with_injected_failure", len(z), "keys/sec",
+              faulted)
+    else:
+        # Injection needs a mesh to lose a device from; on a single-device
+        # host the 'with failure' label would be a lie — measure and say so.
+        log.warning("config5: <4 devices, failure injection inactive")
+        ss5 = SampleSort(mesh)
+        timed("config5_zipf_1M_no_failure_single_device", len(z), "keys/sec",
+              lambda: ss5.sort(z))
     return 0
 
 

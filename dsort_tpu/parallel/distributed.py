@@ -115,6 +115,58 @@ def _build_mh_program(
     )
 
 
+def _agree_cap(n_items: int, n_local_devices: int) -> int:
+    """One global per-device shard capacity, agreed across unequal hosts."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    my_cap = -(-max(n_items, 1) // (8 * n_local_devices)) * 8
+    caps = multihost_utils.process_allgather(np.asarray([my_cap], np.int64))
+    return int(np.max(caps))
+
+
+def _cap_pair_for(factor: float, cap: int, p_total: int) -> int:
+    """Static per-(src,dst) bucket capacity, 8-aligned (shared formula)."""
+    import numpy as np
+
+    return max(-(-int(np.ceil(factor * cap / p_total)) // 8) * 8, 8)
+
+
+def _per_host_egress(out_counts, arrays):
+    """This host's trimmed slices of sharded outputs + its global offset.
+
+    ``arrays``: list of ``(global_array, trailing_shape)`` all sharded over
+    the same leading axis as ``out_counts``.  Reads only addressable shards
+    (device order), trims each device's run to its valid count, and computes
+    the host slice's global offset as the valid-count total of all earlier
+    devices (process-major device order matches `process_allgather`).
+    Returns ``(list_of_local_arrays, offset)``.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    def rows(g):
+        return sorted(g.addressable_shards, key=lambda s: s.index[0].start)
+
+    local_counts = np.concatenate(
+        [np.asarray(s.data).reshape(-1) for s in rows(out_counts)]
+    )
+    outs = []
+    first_dev = 0
+    for i, (garr, trailing) in enumerate(arrays):
+        rs = rows(garr)
+        data_rows = [np.asarray(s.data).reshape((-1,) + trailing) for s in rs]
+        outs.append(
+            np.concatenate([r[: int(c)] for r, c in zip(data_rows, local_counts)])
+        )
+        if i == 0:
+            per_dev = data_rows[0].shape[0]
+            first_dev = rs[0].index[0].start // per_dev if per_dev else 0
+    all_counts = multihost_utils.process_allgather(local_counts)
+    offset = int(np.asarray(all_counts).reshape(-1)[:first_dev].sum())
+    return outs, offset
+
+
 def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
     """Pod-wide sort with per-host ingest/egress (call from EVERY process).
 
@@ -133,7 +185,6 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
     """
     import jax.numpy as jnp
     import numpy as np
-    from jax.experimental import multihost_utils
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dsort_tpu.config import JobConfig
@@ -158,10 +209,7 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
     n_local_devices = len(jax.local_devices())
 
     with timer.phase("partition"):
-        # Hosts may hold unequal amounts; agree on one global per-device cap.
-        my_cap = -(-max(len(local_data), 1) // (8 * n_local_devices)) * 8
-        caps = multihost_utils.process_allgather(np.asarray([my_cap], np.int64))
-        cap = int(np.max(caps))
+        cap = _agree_cap(len(local_data), n_local_devices)
         shards, counts = pad_to_shards(local_data, n_local_devices, cap=cap)
 
         sharding = NamedSharding(mesh, P(axis_name))
@@ -172,7 +220,7 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
     any_overflow = jax.jit(jnp.any, out_shardings=replicated)
     factor = job.capacity_factor
     for _ in range(job.max_capacity_retries + 1):
-        cap_pair = max(-(-int(np.ceil(factor * cap / p_total)) // 8) * 8, 8)
+        cap_pair = _cap_pair_for(factor, cap, p_total)
         fn = _build_mh_program(
             mesh, axis_name, p_total, cap_pair, job.oversample,
             job.local_kernel, job.merge_kernel, "keys",
@@ -188,26 +236,8 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
     else:
         raise RuntimeError("sample sort bucket overflow after max retries")
 
-    # Per-host egress: read only this process's addressable shards, in
-    # global device order, and trim each device's run to its valid count.
-    def _local_rows(garr):
-        rows = sorted(garr.addressable_shards, key=lambda s: s.index[0].start)
-        return [np.asarray(s.data).reshape(-1) for s in rows], rows[0].index[0].start
-
     with timer.phase("assemble"):
-        count_rows, _ = _local_rows(out_counts)
-        merged_rows, merged_start = _local_rows(merged)
-        local_counts = np.concatenate(count_rows)
-        local_sorted = np.concatenate(
-            [r[: int(c)] for r, c in zip(merged_rows, local_counts)]
-        )
-        # Global offset of this host's slice = valid keys on earlier devices.
-        all_counts = multihost_utils.process_allgather(local_counts)
-        first_dev = (
-            merged_start // merged_rows[0].shape[0] if merged_rows[0].size else 0
-        )
-        flat_counts = np.asarray(all_counts).reshape(-1)
-        offset = int(flat_counts[:first_dev].sum())
+        (local_sorted,), offset = _per_host_egress(out_counts, [(merged, ())])
     return local_sorted, offset
 
 
@@ -231,7 +261,6 @@ def sort_local_records(
     """
     import jax.numpy as jnp
     import numpy as np
-    from jax.experimental import multihost_utils
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dsort_tpu.config import JobConfig
@@ -239,10 +268,6 @@ def sort_local_records(
     from dsort_tpu.ops.float_order import (
         is_float_key_dtype,
         sort_float_keys_via_uint,
-    )
-    from dsort_tpu.parallel.sample_sort import (
-        _sample_sort_kv2_shard,
-        _sample_sort_kv_shard,
     )
     from dsort_tpu.utils.metrics import Metrics, PhaseTimer
 
@@ -259,26 +284,25 @@ def sort_local_records(
     p_total = int(mesh.shape[axis_name])
     n_local_devices = len(jax.local_devices())
 
-    my_cap = -(-max(len(keys), 1) // (8 * n_local_devices)) * 8
-    caps = multihost_utils.process_allgather(np.asarray([my_cap], np.int64))
-    cap = int(np.max(caps))
-    sk, sv, counts = pad_kv_to_shards(keys, payload, n_local_devices, cap=cap)
+    with timer.phase("partition"):
+        cap = _agree_cap(len(keys), n_local_devices)
+        sk, sv, counts = pad_kv_to_shards(keys, payload, n_local_devices, cap=cap)
 
-    sharding = NamedSharding(mesh, P(axis_name))
-    xs = jax.make_array_from_process_local_data(sharding, sk.reshape(-1))
-    vs = jax.make_array_from_process_local_data(
-        sharding, sv.reshape((-1,) + sv.shape[2:])
-    )
-    cj = jax.make_array_from_process_local_data(sharding, counts)
-    if secondary is not None:
-        ss = pad_to_layout(np.asarray(secondary), counts, cap)
-        sj = jax.make_array_from_process_local_data(sharding, ss.reshape(-1))
+        sharding = NamedSharding(mesh, P(axis_name))
+        xs = jax.make_array_from_process_local_data(sharding, sk.reshape(-1))
+        vs = jax.make_array_from_process_local_data(
+            sharding, sv.reshape((-1,) + sv.shape[2:])
+        )
+        cj = jax.make_array_from_process_local_data(sharding, counts)
+        if secondary is not None:
+            ss = pad_to_layout(np.asarray(secondary), counts, cap)
+            sj = jax.make_array_from_process_local_data(sharding, ss.reshape(-1))
 
     replicated = NamedSharding(mesh, P())
     any_overflow = jax.jit(jnp.any, out_shardings=replicated)
     factor = job.capacity_factor
     for _ in range(job.max_capacity_retries + 1):
-        cap_pair = max(-(-int(np.ceil(factor * cap / p_total)) // 8) * 8, 8)
+        cap_pair = _cap_pair_for(factor, cap, p_total)
         fn = _build_mh_program(
             mesh, axis_name, p_total, cap_pair, job.oversample,
             job.local_kernel, job.merge_kernel,
@@ -298,25 +322,8 @@ def sort_local_records(
     else:
         raise RuntimeError("sample sort bucket overflow after max retries")
 
-    def _local_shards(garr):
-        rows = sorted(garr.addressable_shards, key=lambda s: s.index[0].start)
-        return [np.asarray(s.data) for s in rows], rows[0].index[0].start
-
-    count_rows, _ = _local_shards(out_counts)
-    k_rows, k_start = _local_shards(out_k)
-    v_rows, _ = _local_shards(out_v)
-    local_counts = np.concatenate([r.reshape(-1) for r in count_rows])
-    local_k = np.concatenate(
-        [r.reshape(-1)[: int(c)] for r, c in zip(k_rows, local_counts)]
-    )
-    local_v = np.concatenate(
-        [
-            r.reshape((-1,) + sv.shape[2:])[: int(c)]
-            for r, c in zip(v_rows, local_counts)
-        ]
-    )
-    all_counts = multihost_utils.process_allgather(local_counts)
-    per_dev = k_rows[0].reshape(-1).shape[0]
-    first_dev = k_start // per_dev if per_dev else 0
-    offset = int(np.asarray(all_counts).reshape(-1)[:first_dev].sum())
+    with timer.phase("assemble"):
+        (local_k, local_v), offset = _per_host_egress(
+            out_counts, [(out_k, ()), (out_v, sv.shape[2:])]
+        )
     return local_k, local_v, offset

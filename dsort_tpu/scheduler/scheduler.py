@@ -237,6 +237,7 @@ class SpmdScheduler:
         self.injector = injector
         self.axis = axis_name
         self.table = WorkerTable(len(self.devices), self.job.heartbeat_timeout_s)
+        self._sorters: dict[tuple, object] = {}  # device-id set -> SampleSort
 
     def _live_devices(self) -> list[jax.Device]:
         return [self.devices[i] for i in self.table.live_workers()]
@@ -303,7 +304,6 @@ class SpmdScheduler:
             if not live:
                 raise JobFailedError("job failed: no live devices remain")
             devs = [self.devices[i] for i in live]
-            mesh = Mesh(np.array(devs), (self.axis,))
             try:
                 if ckpt is not None:
                     work = self._local_sort_phase(data, ckpt, metrics)
@@ -312,7 +312,16 @@ class SpmdScheduler:
                 if self.injector is not None:
                     for i in live:
                         self.injector.check(i, "spmd")
-                out = SampleSort(mesh, self.job, self.axis).sort(work, metrics)
+                # Cache the SampleSort per surviving-device set: its _build
+                # lru_cache is keyed on the instance, so a fresh SampleSort
+                # per job would re-trace + recompile the SPMD program every
+                # time (and again after every mesh re-form).
+                key = tuple(d.id for d in devs)
+                ss = self._sorters.get(key)
+                if ss is None:
+                    mesh = Mesh(np.array(devs), (self.axis,))
+                    ss = self._sorters[key] = SampleSort(mesh, self.job, self.axis)
+                out = ss.sort(work, metrics)
                 return out
             except WorkerFailure as e:
                 log.warning(

@@ -192,7 +192,9 @@ def test_bijection_fuzz_random_bit_patterns(dtype, udtype):
     payloads, infinities.  Sorting the mapped uints must equal np.sort on
     the non-NaN part with all NaNs (canonicalized) at the tail."""
     rng = np.random.default_rng(99)
-    bits = rng.integers(0, np.iinfo(udtype).max, 20_000, dtype=udtype)
+    bits = rng.integers(
+        0, np.iinfo(udtype).max, 20_000, dtype=udtype, endpoint=True
+    )
     x = bits.view(dtype)
     got = ordered_uint_to_float(np.sort(float_to_ordered_uint(x)), dtype)
     _check_sorted_like_numpy(got, x)
