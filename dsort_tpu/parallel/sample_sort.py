@@ -437,3 +437,117 @@ class SampleSort:
             keys_out = np.concatenate([mk[i, : c[i]] for i in range(p)])
             vals_out = np.concatenate([mv[i, : c[i]] for i in range(p)])
         return keys_out, vals_out
+
+
+class BatchSampleSort:
+    """Many independent sort jobs at once over a 2-D ``(dp, w)`` mesh.
+
+    The ``dp`` axis batches whole jobs (each job's keys shard over the ``w``
+    worker axis, exactly as in `SampleSort`); one jitted program sorts every
+    job in the batch concurrently.  This is the public face of
+    ``MeshConfig.dp`` — the analogue of serving the reference's job REPL
+    (``server.c:160-167``) many requests at a time instead of one.
+
+    ``sort(jobs)`` takes a list of 1-D host arrays (lengths may differ) and
+    returns the list of sorted arrays.
+    """
+
+    def __init__(self, mesh: Mesh, job: JobConfig | None = None,
+                 axis_name: str = "w", dp_axis_name: str = "dp"):
+        self.mesh = mesh
+        self.axis = axis_name
+        self.dp_axis = dp_axis_name
+        self.job = job or JobConfig()
+        self.num_workers = mesh.shape[axis_name]
+        self.dp = mesh.shape[dp_axis_name]
+
+    @functools.lru_cache(maxsize=32)
+    def _build(self, n_local: int, cap_pair: int):
+        p = self.num_workers
+        shard_fn = functools.partial(
+            _sample_sort_shard,
+            num_workers=p,
+            oversample=self.job.oversample,
+            cap_pair=cap_pair,
+            axis=self.axis,
+            kernel=self.job.local_kernel,
+            merge_kernel=self.job.merge_kernel,
+        )
+
+        def step(xs_b, counts_b):
+            # Per-device block: (jobs_per_dp, n_local) keys + counts.
+            return jax.vmap(shard_fn)(xs_b, counts_b)
+
+        return jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(self.dp_axis, self.axis),) * 2,
+                out_specs=(P(self.dp_axis, self.axis),) * 3,
+                check_vma=False,
+            )
+        )
+
+    def sort(self, jobs, metrics: Metrics | None = None):
+        """Sort a list of host key arrays; returns the sorted list."""
+        metrics = metrics if metrics is not None else Metrics()
+        timer = PhaseTimer(metrics)
+        jobs = [np.asarray(j) for j in jobs]
+        if not jobs:
+            return []
+        if any(j.dtype != jobs[0].dtype for j in jobs):
+            # Packing mixed dtypes into one batch buffer would silently
+            # value-cast keys; refuse loudly.
+            raise TypeError(
+                f"all jobs must share one key dtype, got "
+                f"{sorted({str(j.dtype) for j in jobs})}"
+            )
+        if is_float_key_dtype(jobs[0].dtype):
+            from dsort_tpu.ops.float_order import (
+                float_to_ordered_uint,
+                ordered_uint_to_float,
+            )
+
+            fdt = jobs[0].dtype
+            outs = self.sort([float_to_ordered_uint(j) for j in jobs], metrics)
+            return [ordered_uint_to_float(o, fdt) for o in outs]
+        p, dp = self.num_workers, self.dp
+        # Pad the batch to a multiple of dp jobs (empty filler jobs), and
+        # every job to ONE shared (w, cap) layout so the program is static.
+        n_jobs = len(jobs)
+        batch = -(-n_jobs // dp) * dp
+        per_shard = -(-max([len(j) for j in jobs] + [1]) // p)
+        cap = max(-(-per_shard // 8) * 8, 8)  # ceil/8-align the largest shard
+        with timer.phase("partition"):
+            ks = np.empty((batch, p * cap), dtype=jobs[0].dtype)
+            cs = np.zeros((batch, p), dtype=np.int32)
+            for b in range(batch):
+                data = jobs[b] if b < n_jobs else jobs[0][:0]
+                shards, counts = pad_to_shards(data, p, cap=cap)
+                ks[b] = shards.reshape(-1)
+                cs[b] = counts
+            sharding = NamedSharding(self.mesh, P(self.dp_axis, self.axis))
+            xs = jax.device_put(jnp.asarray(ks), sharding)
+            cj = jax.device_put(jnp.asarray(cs), sharding)
+        factor = self.job.capacity_factor
+        for _ in range(self.job.max_capacity_retries + 1):
+            cap_pair = min(max(-(-int(np.ceil(factor * cap / p)) // 8) * 8, 8), cap)
+            fn = self._build(cap, cap_pair)
+            with timer.phase("spmd_sort"):
+                merged, out_counts, overflow = fn(xs, cj)
+                merged.block_until_ready()
+            if not bool(np.asarray(overflow).any()):
+                break
+            metrics.bump("capacity_retries")
+            factor *= 2.0
+            log.warning("batch overflow: retrying with larger capacity")
+        else:
+            raise RuntimeError("sample sort bucket overflow after max retries")
+        with timer.phase("assemble"):
+            m = np.asarray(merged).reshape(batch, p, -1)
+            c = np.asarray(out_counts).reshape(batch, p)
+            outs = [
+                np.concatenate([m[b, i, : c[b, i]] for i in range(p)])
+                for b in range(n_jobs)
+            ]
+        return outs

@@ -198,3 +198,62 @@ def test_sample_sort_kv_bitonic_sentinel_keys(mesh8):
         assert sorted(zip(sk.tolist(), map(bytes, sv))) == sorted(
             zip(keys.tolist(), map(bytes, payload))
         )
+
+
+def _mesh_dp2(devices):
+    from dsort_tpu.config import MeshConfig
+    from dsort_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(MeshConfig(num_workers=4, dp=2), devices[:8])
+
+
+def test_batch_sample_sort_many_jobs(devices):
+    """Public MeshConfig.dp path: a batch of unequal jobs, one SPMD program."""
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    rng = np.random.default_rng(21)
+    jobs = [
+        rng.integers(-(10**6), 10**6, n).astype(np.int32)
+        for n in (5000, 1, 0, 777, 4096, 9999, 12)
+    ]
+    outs = BatchSampleSort(mesh).sort(jobs)
+    assert len(outs) == len(jobs)
+    for j, o in zip(jobs, outs):
+        np.testing.assert_array_equal(o, np.sort(j))
+
+
+def test_batch_sample_sort_float_nan(devices):
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    rng = np.random.default_rng(22)
+    jobs = []
+    for n in (1000, 3000):
+        x = rng.normal(size=n).astype(np.float32)
+        x[::53] = np.nan
+        jobs.append(x)
+    outs = BatchSampleSort(mesh).sort(jobs)
+    for j, o in zip(jobs, outs):
+        expect = np.sort(j)
+        k = len(j) - np.isnan(j).sum()
+        np.testing.assert_array_equal(o[:k], expect[:k])
+        assert np.isnan(o[k:]).all()
+
+
+def test_batch_sample_sort_skew_retry(devices):
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    zipf = (gen_zipf(4000, a=1.2, seed=23) % 100_000).astype(np.int32)
+    jobs = [np.full(4000, 7, np.int32), zipf]
+    m = Metrics()
+    outs = BatchSampleSort(mesh, JobConfig(oversample=4)).sort(jobs, metrics=m)
+    for j, o in zip(jobs, outs):
+        np.testing.assert_array_equal(o, np.sort(j))
+    # mixed dtypes must be refused, not silently value-cast
+    import pytest as _pytest
+
+    with _pytest.raises(TypeError):
+        BatchSampleSort(mesh).sort([jobs[0], jobs[1].astype(np.int64)])
