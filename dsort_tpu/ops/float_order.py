@@ -73,6 +73,19 @@ def ordered_uint_to_float(m: np.ndarray, float_dtype) -> np.ndarray:
     return np.where(m == umax, np.array(np.nan, float_dtype), out)
 
 
+def sort_float_key_batch_via_uint(sort_fn, jobs, *args, **kwargs):
+    """Batched form of `sort_float_keys_via_uint`: a LIST of float key arrays.
+
+    ``sort_fn(mapped_jobs, *args, **kwargs)`` returns the list of sorted key
+    arrays.  Same single-boundary rule: batch drivers go through here.
+    """
+    fdt = np.asarray(jobs[0]).dtype
+    outs = sort_fn(
+        [float_to_ordered_uint(np.asarray(j)) for j in jobs], *args, **kwargs
+    )
+    return [ordered_uint_to_float(o, fdt) for o in outs]
+
+
 def sort_float_keys_via_uint(sort_fn, keys: np.ndarray, *args, **kwargs):
     """Run a key sort through the bijection: map, sort as uints, unmap.
 

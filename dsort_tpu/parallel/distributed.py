@@ -126,10 +126,10 @@ def _agree_cap(n_items: int, n_local_devices: int) -> int:
 
 
 def _cap_pair_for(factor: float, cap: int, p_total: int) -> int:
-    """Static per-(src,dst) bucket capacity, 8-aligned (shared formula)."""
-    import numpy as np
+    """The shared capacity policy (see `sample_sort.cap_pair_policy`)."""
+    from dsort_tpu.parallel.sample_sort import cap_pair_policy
 
-    return max(-(-int(np.ceil(factor * cap / p_total)) // 8) * 8, 8)
+    return cap_pair_policy(cap, factor, p_total)
 
 
 def _per_host_egress(out_counts, arrays):

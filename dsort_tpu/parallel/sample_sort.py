@@ -43,6 +43,19 @@ from dsort_tpu.utils.metrics import Metrics, PhaseTimer
 log = get_logger("sample_sort")
 
 
+def cap_pair_policy(n_local: int, factor: float, num_workers: int) -> int:
+    """Static per-(src,dst) bucket capacity: ceil'd, 8-aligned, clamped.
+
+    THE capacity policy — every driver (single-job, batched, multi-host)
+    derives its all_to_all buffer size here, so a headroom/alignment tuning
+    lands everywhere at once.  Never exceeds ``n_local`` (a bucket cannot
+    hold more than the shard's valid keys), never below 8.
+    """
+    cap = int(np.ceil(factor * n_local / num_workers))
+    cap = min(-(-cap // 8) * 8, max(n_local, 8))
+    return max(cap, 8)
+
+
 def _choose_splitters(xs_sorted, count, num_workers: int, oversample: int, axis: str):
     """Per-device samples -> all_gather -> P-1 global splitters (replicated)."""
     s = oversample
@@ -311,10 +324,7 @@ class SampleSort:
         )
 
     def _cap_pair(self, n_local: int, factor: float) -> int:
-        """Static per-(src,dst) bucket capacity, 8-aligned, <= n_local."""
-        cap = int(np.ceil(factor * n_local / self.num_workers))
-        cap = min(-(-cap // 8) * 8, max(n_local, 8))
-        return max(cap, 8)
+        return cap_pair_policy(n_local, factor, self.num_workers)
 
     def sort(self, data: np.ndarray, metrics: Metrics | None = None) -> np.ndarray:
         """Sort a host array; returns the globally sorted host array.
@@ -503,14 +513,9 @@ class BatchSampleSort:
                 f"{sorted({str(j.dtype) for j in jobs})}"
             )
         if is_float_key_dtype(jobs[0].dtype):
-            from dsort_tpu.ops.float_order import (
-                float_to_ordered_uint,
-                ordered_uint_to_float,
-            )
+            from dsort_tpu.ops.float_order import sort_float_key_batch_via_uint
 
-            fdt = jobs[0].dtype
-            outs = self.sort([float_to_ordered_uint(j) for j in jobs], metrics)
-            return [ordered_uint_to_float(o, fdt) for o in outs]
+            return sort_float_key_batch_via_uint(self.sort, jobs, metrics)
         p, dp = self.num_workers, self.dp
         # Pad the batch to a multiple of dp jobs (empty filler jobs), and
         # every job to ONE shared (w, cap) layout so the program is static.
@@ -531,7 +536,7 @@ class BatchSampleSort:
             cj = jax.device_put(jnp.asarray(cs), sharding)
         factor = self.job.capacity_factor
         for _ in range(self.job.max_capacity_retries + 1):
-            cap_pair = min(max(-(-int(np.ceil(factor * cap / p)) // 8) * 8, 8), cap)
+            cap_pair = cap_pair_policy(cap, factor, p)
             fn = self._build(cap, cap_pair)
             with timer.phase("spmd_sort"):
                 merged, out_counts, overflow = fn(xs, cj)

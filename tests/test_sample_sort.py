@@ -249,9 +249,14 @@ def test_batch_sample_sort_skew_retry(devices):
     zipf = (gen_zipf(4000, a=1.2, seed=23) % 100_000).astype(np.int32)
     jobs = [np.full(4000, 7, np.int32), zipf]
     m = Metrics()
-    outs = BatchSampleSort(mesh, JobConfig(oversample=4)).sort(jobs, metrics=m)
+    outs = BatchSampleSort(
+        mesh, JobConfig(oversample=4, capacity_factor=1.0)
+    ).sort(jobs, metrics=m)
     for j, o in zip(jobs, outs):
         np.testing.assert_array_equal(o, np.sort(j))
+    # the all-equal job MUST have overflowed one bucket and retried — pins
+    # the batch retry loop as actually exercised
+    assert m.counters.get("capacity_retries", 0) >= 1
     # mixed dtypes must be refused, not silently value-cast
     import pytest as _pytest
 
