@@ -18,13 +18,14 @@ def main() -> None:
         sys.argv[3],
         sys.argv[4],
     )
+    nprocs = int(sys.argv[5]) if len(sys.argv) > 5 else 2
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
     jax.distributed.initialize(
-        f"127.0.0.1:{port}", num_processes=2, process_id=pid
+        f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )
 
     import numpy as np

@@ -28,7 +28,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_cluster(tmp_path, dtype: str) -> None:
+def _run_cluster(tmp_path, dtype: str, nprocs: int = 2) -> None:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -36,12 +36,13 @@ def _run_cluster(tmp_path, dtype: str) -> None:
     port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, _PROC, str(pid), str(port), str(tmp_path), dtype],
+            [sys.executable, _PROC, str(pid), str(port), str(tmp_path), dtype,
+             str(nprocs)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
         )
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     try:
         for p in procs:
@@ -54,17 +55,21 @@ def _run_cluster(tmp_path, dtype: str) -> None:
                 p.wait(timeout=10)
 
 
-def _check(tmp_path, sort_like_numpy) -> None:
-    ins = [np.load(tmp_path / f"in_{i}.npy") for i in range(2)]
-    outs = [np.load(tmp_path / f"out_{i}.npy") for i in range(2)]
+def _check(tmp_path, sort_like_numpy, nprocs: int = 2) -> None:
+    ins = [np.load(tmp_path / f"in_{i}.npy") for i in range(nprocs)]
+    outs = [np.load(tmp_path / f"out_{i}.npy") for i in range(nprocs)]
     offs = [
-        json.load(open(tmp_path / f"meta_{i}.json"))["offset"] for i in range(2)
+        json.load(open(tmp_path / f"meta_{i}.json"))["offset"]
+        for i in range(nprocs)
     ]
     got = np.concatenate(outs)
     allin = np.concatenate(ins)
     assert len(got) == len(allin)
     # Offsets stitch the slices back contiguously in global order.
-    assert offs[0] == 0 and offs[1] == len(outs[0])
+    expect_off = 0
+    for i in range(nprocs):
+        assert offs[i] == expect_off
+        expect_off += len(outs[i])
     sort_like_numpy(got, allin)
 
 
@@ -73,6 +78,17 @@ def test_two_process_cluster_int32(tmp_path):
     _check(
         tmp_path,
         lambda got, allin: np.testing.assert_array_equal(got, np.sort(allin)),
+    )
+
+
+def test_three_process_cluster_int32(tmp_path):
+    """3 processes x 2 devices: odd process counts exercise the process-major
+    device-order/offset math beyond the 2-way split."""
+    _run_cluster(tmp_path, "int32", nprocs=3)
+    _check(
+        tmp_path,
+        lambda got, allin: np.testing.assert_array_equal(got, np.sort(allin)),
+        nprocs=3,
     )
 
 
