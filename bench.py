@@ -97,7 +97,11 @@ def main() -> None:
         t0 = time.perf_counter()
         _ = np.asarray(f(x)[-1:])  # tiny D2H copy = true completion barrier
         times.append(time.perf_counter() - t0)
-    dt = float(np.median(times)) / chain
+    # min, not median: timer noise here (relay-tunnel jitter on the
+    # completion barrier) is strictly additive, so the fastest rep is the
+    # closest estimate of the true cost (observed 630-740 Mkeys/s run-to-run
+    # spread under median).
+    dt = float(min(times)) / chain
     keys_per_sec = n / dt
 
     chip = jax.devices()[0].platform
