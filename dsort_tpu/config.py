@@ -92,7 +92,7 @@ class JobConfig:
 
     key_dtype: Any = jnp.int32
     payload_bytes: int = 0          # 0 → key-only sort; >0 → TeraSort-style records
-    local_kernel: str = "lax"       # per-chip sort: "lax" | "bitonic" | "pallas" | "radix"
+    local_kernel: str = "lax"       # per-chip sort: "lax" | "block" | "bitonic" | "pallas" | "radix"
     merge_kernel: str = "sort"      # post-shuffle combine: "sort" | "bitonic"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device

@@ -81,19 +81,26 @@ def sort_kv(
     return out_k, _apply_perm(payload, perm, keys.ndim - 1)
 
 
-LOCAL_KERNELS = ("lax", "bitonic", "pallas", "radix")
+LOCAL_KERNELS = ("lax", "block", "bitonic", "pallas", "radix")
 
 
 def sort_with_kernel(keys: jax.Array, kernel: str = "lax") -> jax.Array:
     """Dispatch a 1-D ascending sort to one of the local kernel families.
 
-    - ``lax``: XLA's built-in sort (the default; best all-round on TPU);
+    - ``lax``: XLA's built-in sort (the default; safe everywhere);
+    - ``block``: the fused block-bitonic Pallas kernel (``ops.block_sort``) —
+      the fastest single-chip kernel (measured 1.48 Gkeys/s vs lax's
+      0.43 Gkeys/s at 2^24 int32 on TPU v5e);
     - ``bitonic``: the pure-jnp vectorized bitonic network (``ops.bitonic``);
     - ``pallas``: the Pallas VMEM tile-sort kernel (``ops.pallas_sort``);
     - ``radix``: the stable LSD counting-sort radix (``ops.radix``).
     """
     if kernel == "lax":
         return sort_keys(keys)
+    if kernel == "block":
+        from dsort_tpu.ops.block_sort import block_sort
+
+        return block_sort(keys)
     if kernel == "bitonic":
         from dsort_tpu.ops.bitonic import bitonic_sort
 
