@@ -171,6 +171,10 @@ def _bench_suite(args) -> int:
             "value": round(n / dt, 1),
             "unit": unit,
             "vs_baseline": round(n / dt / _REF_KEYS_PER_SEC, 2),
+            # host->host timing of the public API: includes device dispatch
+            # and (through the axon tunnel) a ~0.1-0.6 s relay round-trip,
+            # which dominates the small configs — see README "Performance".
+            "includes_host_roundtrip": True,
         }))
 
     ss32 = SampleSort(mesh)

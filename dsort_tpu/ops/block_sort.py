@@ -266,7 +266,11 @@ def _sort_levels(x2d, rows: int, k_start: int, parity: bool, interpret: bool):
     import jax.experimental.pallas as pl
 
     t = x2d.shape[0] // rows
-    return pl.pallas_call(
+    # Trace with x64 disabled: the framework enables jax_enable_x64 globally
+    # (int64 key dtypes), which makes jnp promote gather indices to int64 —
+    # unsupported inside Mosaic kernels.  Everything here is 32-bit.
+    with jax.enable_x64(False):
+        return pl.pallas_call(
         functools.partial(
             _sort_levels_kernel,
             rows=rows,
@@ -286,7 +290,8 @@ def _cross(x2d, k_over_b, rows: int, m: int, interpret: bool):
     import jax.experimental.pallas as pl
 
     t = x2d.shape[0] // rows
-    return pl.pallas_call(
+    with jax.enable_x64(False):  # see _sort_levels
+        return pl.pallas_call(
         functools.partial(_cross_kernel, m=m),
         out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
         grid=(t,),
@@ -301,7 +306,8 @@ def _merge_tail(x2d, k_over_b, rows: int, interpret: bool):
     import jax.experimental.pallas as pl
 
     t = x2d.shape[0] // rows
-    return pl.pallas_call(
+    with jax.enable_x64(False):  # see _sort_levels
+        return pl.pallas_call(
         functools.partial(_merge_tail_kernel, rows=rows),
         out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
         grid=(t,),
@@ -327,6 +333,11 @@ def block_sort(
     n = x.shape[0]
     if n <= 1:
         return x
+    if jnp.dtype(x.dtype).itemsize == 8:
+        raise ValueError(
+            "block_sort is a 32-bit kernel (Mosaic has no 64-bit lanes); "
+            "use kernel='lax' for int64/uint64/float64 keys"
+        )
     for name, v in (("block_rows", block_rows), ("tile_rows", tile_rows)):
         if v < 8 or v & (v - 1):
             raise ValueError(f"{name} must be a power of two >= 8, got {v}")

@@ -98,6 +98,8 @@ def sort_with_kernel(keys: jax.Array, kernel: str = "lax") -> jax.Array:
     if kernel == "lax":
         return sort_keys(keys)
     if kernel == "block":
+        if jnp.dtype(keys.dtype).itemsize == 8:
+            return sort_keys(keys)  # Mosaic is 32-bit; lax covers wide keys
         from dsort_tpu.ops.block_sort import block_sort
 
         return block_sort(keys)
