@@ -75,6 +75,32 @@ class ShardCheckpoint:
                 out.append(int(name[len("shard_"):-len(".npy")]))
         return sorted(out)
 
+    # -- shuffle-output ranges (SPMD phase-B checkpoint, SURVEY.md §5.4) --
+    # Separate namespace from "shard_": shards are *local-sort* outputs keyed
+    # by input position; ranges are *shuffle* outputs keyed by key interval.
+
+    def _range_path(self, range_id: int) -> str:
+        return os.path.join(self.dir, f"range_{range_id:05d}.npy")
+
+    def has_range(self, range_id: int) -> bool:
+        return os.path.exists(self._range_path(range_id))
+
+    def save_range(self, range_id: int, arr: np.ndarray) -> None:
+        path = self._range_path(range_id)
+        tmp = path + ".tmp.npy"
+        np.save(tmp, np.asarray(arr))
+        os.replace(tmp, path)
+
+    def load_range(self, range_id: int) -> np.ndarray:
+        return np.load(self._range_path(range_id))
+
+    def completed_ranges(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("range_") and name.endswith(".npy"):
+                out.append(int(name[len("range_"):-len(".npy")]))
+        return sorted(out)
+
     def clear(self) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)
         os.makedirs(self.dir, exist_ok=True)

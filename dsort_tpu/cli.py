@@ -166,16 +166,20 @@ def _bench_suite(args) -> int:
             fn()
             times.append(time.perf_counter() - t0)
         dt = float(np.median(times))
-        print(json.dumps({
+        line = {
             "metric": label,
             "value": round(n / dt, 1),
             "unit": unit,
-            "vs_baseline": round(n / dt / _REF_KEYS_PER_SEC, 2),
             # host->host timing of the public API: includes device dispatch
             # and (through the axon tunnel) a ~0.1-0.6 s relay round-trip,
             # which dominates the small configs — see README "Performance".
             "includes_host_roundtrip": True,
-        }))
+        }
+        if unit == "keys/sec":
+            # rec/sec vs the reference's keys/sec is not apples-to-apples;
+            # only same-unit configs get a vs_baseline ratio (ADVICE r1).
+            line["vs_baseline"] = round(n / dt / _REF_KEYS_PER_SEC, 2)
+        print(json.dumps(line))
 
     ss32 = SampleSort(mesh)
     ref = gen_uniform(16_384, seed=0)

@@ -102,6 +102,7 @@ class JobConfig:
     max_reassign_attempts: int | None = None  # None → up to num_workers - 1
     settle_delay_s: float = 0.1     # reference's 100 ms usleep (server.c:304,391,446)
     heartbeat_timeout_s: float = 10.0  # fixes the reference's hang-blindness
+    max_transient_retries: int = 2  # real runtime error, all devices healthy
     checkpoint_dir: str | None = None  # persist sorted shards for partial recovery
 
     def __post_init__(self) -> None:
@@ -128,6 +129,10 @@ class JobConfig:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")
         if self.capacity_factor < 1.0:
             raise ConfigError(f"capacity_factor must be >= 1.0, got {self.capacity_factor}")
+        if self.max_transient_retries < 0:
+            raise ConfigError(
+                f"max_transient_retries must be >= 0, got {self.max_transient_retries}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

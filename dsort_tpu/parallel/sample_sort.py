@@ -336,14 +336,33 @@ class SampleSort:
         data = np.asarray(data)
         if is_float_key_dtype(data.dtype):
             return sort_float_keys_via_uint(self.sort, data, metrics)
+        if len(data) == 0:
+            return np.asarray(data).copy()
+        return np.concatenate(self.sort_ranges(data, metrics))
+
+    def sort_ranges(
+        self, data: np.ndarray, metrics: Metrics | None = None
+    ) -> list[np.ndarray]:
+        """Like `sort`, but returns the per-device key ranges separately.
+
+        Range ``i`` holds the ``i``-th interval of the key space (ranges
+        concatenate to the sorted output) — the unit the SPMD scheduler
+        persists for shuffle-phase recovery (SURVEY.md §5.4).  Callers
+        handle float keys themselves (`SpmdScheduler` maps them to ordered
+        uints *before* any checkpointed phase).
+        """
+        data = np.asarray(data)
+        if is_float_key_dtype(data.dtype):
+            raise TypeError(
+                "sort_ranges takes pre-mapped keys; use sort() for floats"
+            )
+        if len(data) == 0:
+            return [data.copy()]
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         p = self.num_workers
-        if len(data) == 0:
-            return np.asarray(data).copy()
         with timer.phase("partition"):
             shards, counts = pad_to_shards(data, p)
-            sharding = NamedSharding(self.mesh, P(self.axis, None))
             xs = jax.device_put(
                 jnp.asarray(shards).reshape(-1), NamedSharding(self.mesh, P(self.axis))
             )
@@ -370,8 +389,7 @@ class SampleSort:
         with timer.phase("assemble"):
             m = np.asarray(merged).reshape(p, -1)
             c = np.asarray(out_counts)
-            out = np.concatenate([m[i, : c[i]] for i in range(p)])
-        return out
+            return [m[i, : c[i]] for i in range(p)]
 
     def sort_kv(
         self,
@@ -499,9 +517,17 @@ class BatchSampleSort:
         )
 
     def sort(self, jobs, metrics: Metrics | None = None):
-        """Sort a list of host key arrays; returns the sorted list."""
+        """Sort a list of host key arrays; returns the sorted list.
+
+        Jobs are grouped into **size buckets** (per-shard capacity rounded up
+        to a power of two) and each bucket runs as its own uniform batch, so
+        one 16M-key job in a batch of 1K-key jobs no longer makes every dp
+        slot pay the 16M layout (the padded volume drops ~dp-fold; metrics
+        counter ``padded_elems`` records what was actually allocated).
+        Power-of-two rounding bounds the number of distinct compiled
+        programs at log2(largest/smallest).
+        """
         metrics = metrics if metrics is not None else Metrics()
-        timer = PhaseTimer(metrics)
         jobs = [np.asarray(j) for j in jobs]
         if not jobs:
             return []
@@ -516,13 +542,36 @@ class BatchSampleSort:
             from dsort_tpu.ops.float_order import sort_float_key_batch_via_uint
 
             return sort_float_key_batch_via_uint(self.sort, jobs, metrics)
+        p = self.num_workers
+
+        def bucket_cap(n: int) -> int:
+            per_shard = max(-(-n // p), 1)
+            cap = 8
+            while cap < per_shard:
+                cap *= 2
+            return cap
+
+        buckets: dict[int, list[int]] = {}
+        for i, j in enumerate(jobs):
+            buckets.setdefault(bucket_cap(len(j)), []).append(i)
+        outs: list = [None] * len(jobs)
+        for cap in sorted(buckets):
+            idxs = buckets[cap]
+            for i, out in zip(idxs, self._sort_bucket(
+                [jobs[i] for i in idxs], cap, metrics
+            )):
+                outs[i] = out
+        return outs
+
+    def _sort_bucket(self, jobs, cap: int, metrics: Metrics):
+        """Sort one uniform-capacity batch (every job fits (w, cap))."""
+        timer = PhaseTimer(metrics)
         p, dp = self.num_workers, self.dp
         # Pad the batch to a multiple of dp jobs (empty filler jobs), and
         # every job to ONE shared (w, cap) layout so the program is static.
         n_jobs = len(jobs)
         batch = -(-n_jobs // dp) * dp
-        per_shard = -(-max([len(j) for j in jobs] + [1]) // p)
-        cap = max(-(-per_shard // 8) * 8, 8)  # ceil/8-align the largest shard
+        metrics.bump("padded_elems", batch * p * cap)
         with timer.phase("partition"):
             ks = np.empty((batch, p * cap), dtype=jobs[0].dtype)
             cs = np.zeros((batch, p), dtype=np.int32)

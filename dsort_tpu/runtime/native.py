@@ -304,6 +304,14 @@ def kway_merge_kv2(
             raise ValueError(
                 f"run lengths differ: k1={len(k1)} k2={len(k2)} v={len(v)}"
             )
+        # Row shape/dtype must match across runs: pbytes below is taken from
+        # val_runs[0], so a mismatched run would be strided wrong in native
+        # code (silent record corruption / out-of-bounds reads).
+        if v.shape[1:] != val_runs[0].shape[1:] or v.dtype != val_runs[0].dtype:
+            raise ValueError(
+                f"val run layout differs: {v.dtype}{v.shape[1:]} vs "
+                f"{val_runs[0].dtype}{val_runs[0].shape[1:]}"
+            )
     row = val_runs[0].shape[1:]
     pbytes = int(np.prod(row) * val_runs[0].itemsize)
     total = sum(len(r) for r in k1_runs)

@@ -31,6 +31,55 @@ class JobFailedError(RuntimeError):
     """
 
 
+#: Status prefixes that indicate the device/runtime itself failed — the
+#: in-band signal a dying chip actually produces (the reference's equivalent
+#: is a failed ``send()/recv()``, ``server.c:358,421-448``).  Deliberately a
+#: conservative allowlist: program bugs (INVALID_ARGUMENT), missing features
+#: (UNIMPLEMENTED) and OOM (RESOURCE_EXHAUSTED — re-running on a *smaller*
+#: mesh would only OOM harder) must NOT masquerade as device death.
+_DEVICE_ERROR_PREFIXES = (
+    "INTERNAL",
+    "UNAVAILABLE",
+    "ABORTED",
+    "CANCELLED",
+    "DATA_LOSS",
+    "DEADLINE_EXCEEDED",
+)
+
+
+def _runtime_error_types() -> tuple[type, ...]:
+    types: list[type] = []
+    try:
+        from jax.errors import JaxRuntimeError
+
+        types.append(JaxRuntimeError)
+    except ImportError:  # pragma: no cover - jax always present here
+        pass
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        types.append(XlaRuntimeError)
+    except ImportError:
+        pass
+    return tuple(types)
+
+
+def is_device_runtime_error(exc: BaseException) -> bool:
+    """True iff ``exc`` is a JAX/XLA runtime error that signals device loss.
+
+    Used by both schedulers to route *real* runtime failures (not just the
+    test injector's `WorkerFailure`) into mark-dead + reassign/re-form.
+    Classification is by the gRPC-style status prefix of the message
+    (``"INTERNAL: ..."`` etc.); anything not on the allowlist propagates to
+    the caller as a genuine error.
+    """
+    types = _runtime_error_types()
+    if not types or not isinstance(exc, types):
+        return False
+    msg = str(exc).lstrip()
+    return msg.startswith(_DEVICE_ERROR_PREFIXES)
+
+
 class FaultInjector:
     """Programmable failure source, threaded through the executor.
 

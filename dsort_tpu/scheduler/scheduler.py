@@ -39,7 +39,12 @@ from dsort_tpu.config import JobConfig
 from dsort_tpu.data.partition import partition
 from dsort_tpu.ops.float_order import is_float_key_dtype, sort_float_keys_via_uint
 from dsort_tpu.ops.merge import merge_sorted_host
-from dsort_tpu.scheduler.fault import FaultInjector, JobFailedError, WorkerFailure
+from dsort_tpu.scheduler.fault import (
+    FaultInjector,
+    JobFailedError,
+    WorkerFailure,
+    is_device_runtime_error,
+)
 from dsort_tpu.scheduler.liveness import WorkerTable
 from dsort_tpu.utils.logging import get_logger
 from dsort_tpu.utils.metrics import Metrics, PhaseTimer
@@ -125,7 +130,13 @@ class Scheduler:
         return box["r"]
 
     def _handle_shard(
-        self, i: int, shard: np.ndarray, results: list, metrics: Metrics, ckpt=None
+        self,
+        i: int,
+        shard: np.ndarray,
+        results: list,
+        metrics: Metrics,
+        ckpt=None,
+        errors: list | None = None,
     ) -> None:
         """One shard's lifecycle: the worker_handler attempt loop."""
         if ckpt is not None and ckpt.has(i):
@@ -145,8 +156,21 @@ class Scheduler:
                 if ckpt is not None:
                     ckpt.save(i, results[i])
                 return  # result pinned to slot i (server.c:415)
-            except (WorkerFailure, TimeoutError) as e:
-                stage = getattr(e, "stage", "timeout")
+            except Exception as e:
+                if isinstance(e, (WorkerFailure, TimeoutError)):
+                    stage = getattr(e, "stage", "timeout")
+                elif is_device_runtime_error(e):
+                    # A *real* XLA runtime failure from the device — the
+                    # send()/recv()<=0 analogue (server.c:358,421-448) — is
+                    # handled exactly like an injected failure.  Anything
+                    # else (program bug, OOM) propagates to the job caller.
+                    stage = "device-runtime"
+                    metrics.bump("device_runtime_errors")
+                else:
+                    if errors is not None:
+                        errors[i] = e
+                        return
+                    raise
                 log.warning(
                     "worker %d failed during %s of shard %d; reassigning",
                     worker, stage, i,
@@ -194,11 +218,12 @@ class Scheduler:
         with timer.phase("partition"):
             shards = partition(np.asarray(data), w)
         results: list[np.ndarray | None] = [None] * w
+        errors: list[BaseException | None] = [None] * w
         with timer.phase("dispatch"):
             threads = [
                 threading.Thread(
                     target=self._handle_shard,
-                    args=(i, shards[i], results, metrics, ckpt),
+                    args=(i, shards[i], results, metrics, ckpt, errors),
                 )
                 for i in range(w)
             ]
@@ -206,6 +231,9 @@ class Scheduler:
                 t.start()
             for t in threads:
                 t.join()
+        for e in errors:
+            if e is not None:  # a genuine program error, not a worker death
+                raise e
         if any(r is None for r in results):
             raise JobFailedError(
                 "job failed: no live workers remain "
@@ -242,6 +270,52 @@ class SpmdScheduler:
     def _live_devices(self) -> list[jax.Device]:
         return [self.devices[i] for i in self.table.live_workers()]
 
+    def _probe_device(self, idx: int) -> bool:
+        """Tiny bounded round-trip on one device — SPMD's liveness probe.
+
+        A compiled collective reports failure as one exception for the whole
+        mesh; this pinpoints *which* participant is gone.  Bounded by the
+        heartbeat timeout so a hung device counts as dead, and stamps the
+        worker table's heartbeat on success (the table's `check_heartbeats`
+        then reaps anything that hasn't proven life recently).
+        """
+        box: dict = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                y = jax.device_put(np.zeros(8, np.int32), self.devices[idx])
+                box["ok"] = int(np.asarray(y).sum()) == 0
+            except Exception:
+                box["ok"] = False
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        if not done.wait(timeout=self.job.heartbeat_timeout_s) or not box.get("ok"):
+            return False
+        self.table.heartbeat(idx)
+        return True
+
+    def _reap_after_runtime_error(self, live: list[int], metrics: Metrics) -> list[int]:
+        """Probe every live device after a real runtime error; mark the dead.
+
+        Returns the newly dead worker indexes (possibly empty: a transient
+        runtime fault with all devices healthy).
+        """
+        dead = [i for i in live if not self._probe_device(i)]
+        for i in dead:
+            self.table.mark_dead(i)
+        # Belt and braces: reap anything whose heartbeat (stamped by probes
+        # and successful jobs) has lapsed — this is the wired-in consumer of
+        # the table's heartbeat timestamps.
+        for i in self.table.check_heartbeats():
+            if i not in dead:
+                dead.append(i)
+        if dead:
+            metrics.bump("device_deaths", len(dead))
+        return dead
+
     def _local_sort_phase(
         self, data: np.ndarray, ckpt, metrics: Metrics
     ) -> np.ndarray:
@@ -275,6 +349,94 @@ class SpmdScheduler:
             metrics.bump("spmd_phase_restores")
         return np.concatenate([ckpt.load(i) for i in range(w)])
 
+    def _shuffle_with_range_checkpoint(
+        self, work: np.ndarray, ckpt, ss, metrics: Metrics, live: list[int]
+    ) -> np.ndarray:
+        """Phase B with per-range persistence (SURVEY.md §5.4, upgraded).
+
+        The shuffle's output unit is a *key range* (device i's post-
+        ``all_to_all`` merged interval).  Each range persists as soon as it
+        is read back, so a failure mid-assemble (device dying while its
+        range is fetched) costs only the unfetched ranges: the retry
+        restores the persisted ones and re-sorts just the missing key
+        intervals on the re-formed mesh — vs the reference restarting the
+        whole chunk (``server.c:381,436``).
+        """
+        man = ckpt.manifest() or {}
+        n_ranges = man.get("n_ranges")
+        done = ckpt.completed_ranges()
+        if n_ranges is not None and done:
+            if len(done) == n_ranges:
+                metrics.bump("shuffle_phase_restores")
+                return np.concatenate(
+                    [ckpt.load_range(i) for i in sorted(done)]
+                )
+            return self._resume_missing_ranges(work, ckpt, ss, done, metrics)
+        outs = ss.sort_ranges(work, metrics)
+        ckpt.write_manifest(
+            man.get("num_shards", len(self.devices)),
+            work.dtype,
+            man.get("total", len(work)),
+            fingerprint=man.get("fingerprint"),
+            n_ranges=len(outs),
+        )
+        for i, r in enumerate(outs):
+            # Injection point: device `live[i]` dies while its range is read
+            # back — ranges 0..i-1 are already safe on disk.
+            if self.injector is not None:
+                self.injector.check(live[min(i, len(live) - 1)], "assemble")
+            ckpt.save_range(i, r)
+        return np.concatenate(outs)
+
+    def _resume_missing_ranges(
+        self, work: np.ndarray, ckpt, ss, done: list[int], metrics: Metrics
+    ) -> np.ndarray:
+        """Re-sort only the key intervals whose ranges were lost.
+
+        The missing multiset is reconstructed by value: every key strictly
+        inside a persisted range's [min, max] belongs to that range; for
+        keys *equal* to a persisted range's boundary value the missing copy
+        count is (copies in input) - (copies in persisted ranges).  Any
+        consistent placement of equal keys is a valid sort, so the subset is
+        sorted on the (possibly re-formed) mesh and host-merged with the
+        persisted ranges.
+        """
+        present = [ckpt.load_range(i) for i in sorted(done)]
+        nonempty = [r for r in present if len(r)]
+        in_present = np.zeros(len(work), bool)
+        boundary_vals = set()
+        for r in nonempty:
+            lo, hi = r[0], r[-1]
+            in_present |= (work > lo) & (work < hi)
+            boundary_vals.update((lo.item(), hi.item()))
+        subset = work[~in_present & ~np.isin(work, list(boundary_vals))]
+        parts = [subset]
+        for v in boundary_vals:
+            missing_v = int((work == v).sum()) - sum(
+                int((r == v).sum()) for r in nonempty
+            )
+            if missing_v > 0:
+                parts.append(np.full(missing_v, v, dtype=work.dtype))
+        subset = np.concatenate(parts)
+        metrics.bump("shuffle_ranges_restored", len(done))
+        metrics.bump("shuffle_resort_keys", len(subset))
+        log.warning(
+            "shuffle resume: %d/%d ranges restored; re-sorting %d of %d keys",
+            len(done), (ckpt.manifest() or {}).get("n_ranges", -1),
+            len(subset), len(work),
+        )
+        sorted_subset = ss.sort(subset, metrics)
+        present_concat = (
+            np.concatenate(present) if present else subset[:0]
+        )
+        out = merge_sorted_host([present_concat, sorted_subset])
+        if len(out) != len(work):  # reconstruction must be exactly lossless
+            raise JobFailedError(
+                f"shuffle resume reconstructed {len(out)} of {len(work)} "
+                "keys; clearing the checkpoint and re-running is required"
+            )
+        return out
+
     def sort(
         self,
         data: np.ndarray,
@@ -296,9 +458,40 @@ class SpmdScheduler:
         work = data
         if self.job.checkpoint_dir and job_id and len(data):
             from dsort_tpu.checkpoint import ShardCheckpoint
+            from dsort_tpu.models.external_sort import _fingerprint
 
             ckpt = ShardCheckpoint(self.job.checkpoint_dir, job_id)
-            ckpt.write_manifest(len(self.devices), np.asarray(data).dtype, len(data))
+            # Trust checkpointed state only if it came from THIS data: a
+            # reused job_id with different same-length data must not serve
+            # stale shards/ranges (same guard as ExternalSort's
+            # _sync_manifest — ADVICE r1).
+            fp = _fingerprint(data)
+            m = ckpt.manifest()
+            have_state = bool(ckpt.completed_shards() or ckpt.completed_ranges())
+            stale = (m is None and have_state) or (
+                m is not None
+                and (
+                    m.get("num_shards") != len(self.devices)
+                    or m.get("dtype") != str(np.asarray(data).dtype)
+                    or m.get("total") != len(data)
+                    or m.get("fingerprint") != fp
+                )
+            )
+            if stale:
+                log.warning(
+                    "job %r: checkpointed state belongs to different data; "
+                    "clearing",
+                    job_id,
+                )
+                ckpt.clear()
+            extra = {}
+            if not stale and m is not None and "n_ranges" in m:
+                extra["n_ranges"] = m["n_ranges"]  # keep the shuffle record
+            ckpt.write_manifest(
+                len(self.devices), np.asarray(data).dtype, len(data),
+                fingerprint=fp, **extra,
+            )
+        transient_retries = 0
         while True:
             live = self.table.live_workers()
             if not live:
@@ -321,7 +514,14 @@ class SpmdScheduler:
                 if ss is None:
                     mesh = Mesh(np.array(devs), (self.axis,))
                     ss = self._sorters[key] = SampleSort(mesh, self.job, self.axis)
-                out = ss.sort(work, metrics)
+                if ckpt is None:
+                    out = ss.sort(work, metrics)
+                else:
+                    out = self._shuffle_with_range_checkpoint(
+                        work, ckpt, ss, metrics, live
+                    )
+                for i in live:  # proof of life: the collective completed
+                    self.table.heartbeat(i)
                 return out
             except WorkerFailure as e:
                 log.warning(
@@ -330,4 +530,35 @@ class SpmdScheduler:
                 )
                 self.table.mark_dead(e.worker)
                 metrics.bump("mesh_reforms")
+                time.sleep(self.job.settle_delay_s)
+            except Exception as e:
+                # A *real* runtime failure from the mesh (XLA reports one
+                # exception for the whole collective).  Probe to find which
+                # participant died; with every device healthy it was a
+                # transient fault — retry a bounded number of times.
+                if not is_device_runtime_error(e):
+                    raise
+                metrics.bump("device_runtime_errors")
+                dead = self._reap_after_runtime_error(live, metrics)
+                if dead:
+                    log.warning(
+                        "runtime error (%s); devices %s dead, re-forming "
+                        "mesh over %d survivors",
+                        str(e).splitlines()[0][:120],
+                        dead,
+                        len(live) - len(dead),
+                    )
+                    metrics.bump("mesh_reforms")
+                elif transient_retries < self.job.max_transient_retries:
+                    transient_retries += 1
+                    metrics.bump("transient_retries")
+                    log.warning(
+                        "transient runtime error with all devices healthy "
+                        "(retry %d/%d): %s",
+                        transient_retries,
+                        self.job.max_transient_retries,
+                        str(e).splitlines()[0][:120],
+                    )
+                else:
+                    raise
                 time.sleep(self.job.settle_delay_s)

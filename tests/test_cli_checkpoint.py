@@ -190,4 +190,7 @@ def test_cli_bench_suite_runs_all_configs():
     assert [m["metric"][:7] for m in metrics] == [
         f"config{i}" for i in range(1, 6)
     ]
-    assert all(m["value"] > 0 and m["vs_baseline"] > 1 for m in metrics)
+    # Structural only: throughput thresholds are hardware/load-dependent and
+    # belong in the benchmark artifact, not a correctness test (ADVICE r1).
+    assert all(m["value"] > 0 for m in metrics)
+    assert all(("vs_baseline" in m) == (m["unit"] == "keys/sec") for m in metrics)

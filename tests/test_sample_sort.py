@@ -262,3 +262,23 @@ def test_batch_sample_sort_skew_retry(devices):
 
     with _pytest.raises(TypeError):
         BatchSampleSort(mesh).sort([jobs[0], jobs[1].astype(np.int64)])
+
+
+def test_batch_size_bucketing_padded_volume(devices):
+    """One big job must not make every dp slot pay its layout (VERDICT r1):
+    the bucketed padded volume is an order of magnitude below the single-
+    layout scheme's batch * w * max_cap."""
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+    from dsort_tpu.utils.metrics import Metrics
+
+    rng = np.random.default_rng(12)
+    jobs = [rng.integers(-(2**31), 2**31 - 1, 32_768).astype(np.int32)] + [
+        rng.integers(-(2**31), 2**31 - 1, 512).astype(np.int32)
+        for _ in range(63)
+    ]
+    m = Metrics()
+    outs = BatchSampleSort(_mesh_dp2(devices)).sort(jobs, metrics=m)
+    for j, o in zip(jobs, outs):
+        np.testing.assert_array_equal(o, np.sort(j))
+    naive = 64 * 4 * 8192  # 64-job batch all padded to the 32K job's layout
+    assert m.counters["padded_elems"] <= naive // 8
