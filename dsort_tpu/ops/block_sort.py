@@ -61,6 +61,7 @@ from dsort_tpu.ops.local_sort import sentinel_for
 LANES = 128
 TILE_ROWS = 256  # K1 unit: 2^15 elements, 120 fused stages
 BLOCK_ROWS = 1024  # merge-block unit: 2^17 elements = 512 KiB int32 (16 MiB scoped-VMEM fits)
+MULTI_M_HI = 8  # K2b fuses cross distances of 2..8 blocks in one span pass
 
 
 from dsort_tpu.ops.pallas_sort import _on_tpu  # noqa: E402  (shared probe)
@@ -208,6 +209,40 @@ def _cross_kernel(k_ref, x_ref, p_ref, o_ref, *, m: int):
     o_ref[:] = jnp.where(keep_small, small, big)
 
 
+def _multi_cross_kernel(k_ref, x_ref, o_ref, *, rows: int, m_hi: int):
+    """K2b: cross stages at block distances ``m_hi, m_hi/2, .., 2`` fused.
+
+    One grid step owns a *span* of ``2 * m_hi`` blocks, inside which every
+    pair for those distances is local: each stage is a vreg-aligned row
+    exchange (pair view) at ``j = m * rows`` — so a span pass replaces
+    log2(m_hi) separate bandwidth passes with one.  The merge level arrives
+    as an SMEM scalar (``k_ref``, in block units), so one compilation serves
+    every level; the distance-1 stage and the intra-block tail remain K3's.
+    """
+    import jax.experimental.pallas as pl
+
+    span = 2 * m_hi
+    x = x_ref[:]
+    kb = k_ref[0, 0]
+    # Block index of every row in the span (global): span_start + local.
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (span * rows, 1), 0)
+    blk = pl.program_id(0) * span + rowi // rows
+    asc_rows = (blk & kb) == 0  # (span*rows, 1), constant across the level
+    m = m_hi
+    while m >= 2:
+        j = m * rows
+        v = x.reshape(span * rows // (2 * j), 2, j, LANES)
+        a, b = v[:, 0], v[:, 1]
+        lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+        asc = asc_rows.reshape(span * rows // (2 * j), 2, j, 1)[:, 0]
+        out = jnp.stack(
+            [jnp.where(asc, lo, hi), jnp.where(asc, hi, lo)], axis=1
+        )
+        x = out.reshape(span * rows, LANES)
+        m //= 2
+    o_ref[:] = x
+
+
 def _merge_tail_kernel(k_ref, x_ref, p_ref, o_ref, *, rows: int):
     """K3: distance-one-block stage + all intra-block stages, fused.
 
@@ -301,6 +336,30 @@ def _cross(x2d, k_over_b, rows: int, m: int, interpret: bool):
     )(k_over_b, x2d, x2d)
 
 
+@functools.partial(jax.jit, static_argnames=("rows", "m_hi", "interpret"))
+def _multi_cross(x2d, k_over_b, rows: int, m_hi: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    span_rows = 2 * m_hi * rows
+    t = x2d.shape[0] // span_rows
+    spec = pl.BlockSpec(
+        (span_rows, LANES), lambda g: (g, 0), memory_space=pltpu.VMEM
+    )
+    with jax.enable_x64(False):  # see _sort_levels
+        return pl.pallas_call(
+            functools.partial(_multi_cross_kernel, rows=rows, m_hi=m_hi),
+            out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+            grid=(t,),
+            in_specs=[_smem_scalar(), spec],
+            out_specs=spec,
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 << 20
+            ),
+            interpret=interpret,
+        )(k_over_b, x2d)
+
+
 @functools.partial(jax.jit, static_argnames=("rows", "interpret"))
 def _merge_tail(x2d, k_over_b, rows: int, interpret: bool):
     import jax.experimental.pallas as pl
@@ -366,14 +425,18 @@ def block_sort(
         blk = target
     b = blk * LANES
 
-    # K2/K3: cross-block merge levels.
+    # K2/K2b/K3: cross-block merge levels.  Distances of 2..MULTI_M_HI
+    # blocks fuse into one span pass (K2b); larger distances are single
+    # bandwidth passes (K2); distance 1 + the intra-block tail is K3.
     k = 2 * b
     while k <= p:
         kb = jnp.full((1, 1), k // b, jnp.int32)
         m = k // (2 * b)
-        while m >= 2:
+        while m > MULTI_M_HI:
             x2d = _cross(x2d, kb, blk, m, interpret)
             m //= 2
+        if m >= 2:
+            x2d = _multi_cross(x2d, kb, blk, m, interpret)
         x2d = _merge_tail(x2d, kb, blk, interpret)
         k *= 2
     return x2d.reshape(-1)[:n]

@@ -131,7 +131,11 @@ def _tile_bitonic_kv_kernel(k_ref, v_ref, ok_ref, ov_ref, *, rows: int):
         fv, sv = jnp.where(am_first, v, pv), jnp.where(am_first, pv, v)
         first_gt = (fk > sk) | ((fk == sk) & (fv > sv))
         asc = ((row * LANES + lane) & stage) == 0
-        swap = jnp.where(asc, first_gt, ~first_gt & ((fk != sk) | (fv != sv)))
+        # Pure boolean algebra, no select on i1 vectors: Mosaic lowers
+        # jnp.where over bool operands to an unsupported i8->i1 truncate.
+        swap = (asc & first_gt) | (
+            ~asc & ~first_gt & ((fk != sk) | (fv != sv))
+        )
         return jnp.where(swap, pk, k), jnp.where(swap, pv, v)
 
     stage = 2
