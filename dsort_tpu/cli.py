@@ -339,7 +339,7 @@ def cmd_external(args) -> int:
         run_elems=args.run_elems,
         spill_dir=args.spill_dir,
         job_id=args.job_id,
-        local_kernel=args.kernel or "lax",
+        local_kernel=args.kernel or "auto",
         resume=not args.no_resume,
     )
     metrics = Metrics()
@@ -445,7 +445,7 @@ def main(argv=None) -> int:
                        choices=["spmd", "taskpool", "local"])
         p.add_argument("--workers", type=int)
         p.add_argument("--dtype")
-        p.add_argument("--kernel", choices=["lax", "bitonic", "pallas", "radix"])
+        p.add_argument("--kernel", choices=["auto", "lax", "block", "bitonic", "pallas", "radix"])
         p.add_argument("-o", "--output")
 
     p = sub.add_parser("run", help="sort one file")
@@ -494,7 +494,7 @@ def main(argv=None) -> int:
     p.add_argument("input")
     p.add_argument("-o", "--output", required=True)
     p.add_argument("--dtype", default="int32")
-    p.add_argument("--kernel", choices=["lax", "bitonic", "pallas", "radix"])
+    p.add_argument("--kernel", choices=["auto", "lax", "block", "bitonic", "pallas", "radix"])
     p.add_argument("--run-elems", type=int, default=1 << 22)
     p.add_argument("--spill-dir")
     p.add_argument("--job-id", default="external")

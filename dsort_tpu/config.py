@@ -92,7 +92,7 @@ class JobConfig:
 
     key_dtype: Any = jnp.int32
     payload_bytes: int = 0          # 0 → key-only sort; >0 → TeraSort-style records
-    local_kernel: str = "lax"       # per-chip sort: "lax" | "block" | "bitonic" | "pallas" | "radix"
+    local_kernel: str = "auto"      # per-chip sort: "auto" | "lax" | "block" | "bitonic" | "pallas" | "radix"
     merge_kernel: str = "sort"      # post-shuffle combine: "sort" | "bitonic"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
@@ -165,7 +165,7 @@ class SortConfig:
         job = JobConfig(
             key_dtype=jnp.dtype(m.get("KEY_DTYPE", "int32")),
             payload_bytes=geti("PAYLOAD_BYTES", 0),
-            local_kernel=m.get("LOCAL_KERNEL", "lax"),
+            local_kernel=m.get("LOCAL_KERNEL", "auto"),
             merge_kernel=m.get("MERGE_KERNEL", "sort"),
             oversample=geti("OVERSAMPLE", 32),
             capacity_factor=float(m.get("CAPACITY_FACTOR", 2.0)),
