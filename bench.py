@@ -16,7 +16,8 @@ and a phase split of one SPMD sort separating host<->device transfer from
 on-chip compute.
 
 Env knobs: DSORT_BENCH_N (default 2^24), DSORT_BENCH_REPS (default 3),
-DSORT_BENCH_CHAIN (default 16), DSORT_BENCH_KERNEL ("block" | "lax" | ...),
+DSORT_BENCH_CHAIN (default 48 — the ~70-100 ms tunnel round-trip
+divided by the chain length is the residual overhead per measured sort), DSORT_BENCH_KERNEL ("block" | "lax" | ...),
 DSORT_BENCH_SUITE (default 1; 0 = headline lines only).
 
 Timing methodology (unchanged from round 1): `block_until_ready` is
@@ -124,7 +125,7 @@ def main() -> None:
 
     n = int(os.environ.get("DSORT_BENCH_N", 1 << 24))
     reps = int(os.environ.get("DSORT_BENCH_REPS", 3))
-    chain = int(os.environ.get("DSORT_BENCH_CHAIN", 16))
+    chain = int(os.environ.get("DSORT_BENCH_CHAIN", 48))
     if chain < 1:
         raise SystemExit("DSORT_BENCH_CHAIN must be >= 1")
     chip = jax.devices()[0].platform

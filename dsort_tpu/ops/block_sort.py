@@ -280,23 +280,24 @@ def _sort_levels_kernel(
     o_ref[:] = x
 
 
-def _cross_kernel(k_ref, x_ref, p_ref, o_ref, *, m: int):
+def _cross_kernel(k_ref, x_ref, o_ref, *, m: int):
     """K2: one cross-block stage at a distance of ``m >= 2`` blocks.
 
-    Each grid step writes only its own block: min of the pair if this block
-    is the pair's low side in an ascending region (and symmetric cases).
+    The input arrives as a ``(pairs, 2, m, rows, 128)`` view of the array,
+    and each grid step ``(a, c)`` owns the whole pair ``x[a, :, c]`` (two
+    non-adjacent blocks — one strided rectangular DMA), so the stage moves
+    2n bytes instead of the 3n of a read-own+partner/write-own scheme.
     ``k_ref[0,0]`` holds the merge level in block units (k/B); that bit sits
     above ``m``, so both partners agree on the direction.
     """
     import jax.experimental.pallas as pl
 
-    g = pl.program_id(0)
-    am_lo = (g & m) == 0
-    asc = (g & k_ref[0, 0]) == 0
-    keep_small = asc == am_lo
-    small = jnp.minimum(x_ref[:], p_ref[:])
-    big = jnp.maximum(x_ref[:], p_ref[:])
-    o_ref[:] = jnp.where(keep_small, small, big)
+    lo_block = pl.program_id(0) * 2 * m + pl.program_id(1)
+    asc = (lo_block & k_ref[0, 0]) == 0
+    a, b = x_ref[0, 0, 0], x_ref[0, 1, 0]
+    small, big = jnp.minimum(a, b), jnp.maximum(a, b)
+    o_ref[0, 0, 0] = jnp.where(asc, small, big)
+    o_ref[0, 1, 0] = jnp.where(asc, big, small)
 
 
 def _multi_cross_kernel(k_ref, x_ref, o_ref, *, rows: int, m_hi: int):
@@ -333,29 +334,27 @@ def _multi_cross_kernel(k_ref, x_ref, o_ref, *, rows: int, m_hi: int):
     o_ref[:] = x
 
 
-def _merge_tail_kernel(k_ref, x_ref, p_ref, o_ref, *, rows: int):
+def _merge_tail_kernel(k_ref, x_ref, o_ref, *, rows: int):
     """K3: distance-one-block stage + all intra-block stages, fused.
 
-    Reads the block pair, applies the cross exchange, then finishes the
-    bitonic merge of this block entirely in VMEM (single HBM write).
-    Scalar-parametrized by the merge level (``k_ref``), so one compilation
-    serves every level.
+    One grid step owns a contiguous block *pair* (2*rows, 128): it applies
+    the distance-one-block exchange (a row exchange at ``j = rows``), then
+    finishes the bitonic merge of BOTH blocks in VMEM — every sub-block
+    stage distance stays inside its own j-aligned group, so running the
+    helpers on the doubled-height array merges the halves independently.
+    2n bytes moved; scalar-parametrized by the merge level (``k_ref``), so
+    one compilation serves every level.  Both halves share the direction
+    bit (k/B >= 2 sits above the pair).
     """
     import jax.experimental.pallas as pl
 
     g = pl.program_id(0)
-    am_lo = (g & 1) == 0
-    asc = (g & k_ref[0, 0]) == 0
-    keep_small = asc == am_lo
-    x = jnp.where(
-        keep_small,
-        jnp.minimum(x_ref[:], p_ref[:]),
-        jnp.maximum(x_ref[:], p_ref[:]),
-    )
-    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
-    rowi = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
-    # Remaining distances rows*LANES/2 .. 1, uniform direction `asc`.
-    x = _level_stages(x, rows * LANES, rows, lane, rowi, asc_top=asc)
+    asc = ((2 * g) & k_ref[0, 0]) == 0
+    x = _exchange_rows(x_ref[:], rows, asc)  # the distance-B stage
+    lane = jax.lax.broadcasted_iota(jnp.int32, (2 * rows, LANES), 1)
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (2 * rows, LANES), 0)
+    # Remaining distances rows*LANES/2 .. 1 on both halves at once.
+    x = _level_stages(x, rows * LANES, 2 * rows, lane, rowi, asc_top=asc)
     o_ref[:] = x
 
 
@@ -364,15 +363,6 @@ def _vmem(rows):
     from jax.experimental.pallas import tpu as pltpu
 
     return pl.BlockSpec((rows, LANES), lambda g: (g, 0), memory_space=pltpu.VMEM)
-
-
-def _vmem_partner(rows, m):
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pl.BlockSpec(
-        (rows, LANES), lambda g: (g ^ m, 0), memory_space=pltpu.VMEM
-    )
 
 
 def _smem_scalar():
@@ -413,17 +403,26 @@ def _sort_levels(x2d, rows: int, k_start: int, parity: bool, interpret: bool):
 @functools.partial(jax.jit, static_argnames=("rows", "m", "interpret"))
 def _cross(x2d, k_over_b, rows: int, m: int, interpret: bool):
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     t = x2d.shape[0] // rows
+    x5 = x2d.reshape(t // (2 * m), 2, m, rows, LANES)
+    pair_spec = pl.BlockSpec(
+        (1, 2, 1, rows, LANES),
+        lambda a, c: (a, 0, c, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    smem = pl.BlockSpec((1, 1), lambda a, c: (0, 0), memory_space=pltpu.SMEM)
     with jax.enable_x64(False):  # see _sort_levels
-        return pl.pallas_call(
-        functools.partial(_cross_kernel, m=m),
-        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
-        grid=(t,),
-        in_specs=[_smem_scalar(), _vmem(rows), _vmem_partner(rows, m)],
-        out_specs=_vmem(rows),
-        interpret=interpret,
-    )(k_over_b, x2d, x2d)
+        out = pl.pallas_call(
+            functools.partial(_cross_kernel, m=m),
+            out_shape=jax.ShapeDtypeStruct(x5.shape, x5.dtype),
+            grid=(t // (2 * m), m),
+            in_specs=[smem, pair_spec],
+            out_specs=pair_spec,
+            interpret=interpret,
+        )(k_over_b, x5)
+    return out.reshape(x2d.shape)
 
 
 @functools.partial(jax.jit, static_argnames=("rows", "m_hi", "interpret"))
@@ -459,11 +458,11 @@ def _merge_tail(x2d, k_over_b, rows: int, interpret: bool):
         return pl.pallas_call(
         functools.partial(_merge_tail_kernel, rows=rows),
         out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
-        grid=(t,),
-        in_specs=[_smem_scalar(), _vmem(rows), _vmem_partner(rows, 1)],
-        out_specs=_vmem(rows),
+        grid=(t // 2,),
+        in_specs=[_smem_scalar(), _vmem(2 * rows)],
+        out_specs=_vmem(2 * rows),
         interpret=interpret,
-    )(k_over_b, x2d, x2d)
+    )(k_over_b, x2d)
 
 
 def block_sort(
