@@ -106,11 +106,13 @@ def sort_with_kernel(keys: jax.Array, kernel: str = "auto") -> jax.Array:
     if kernel == "auto":
         from dsort_tpu.ops.pallas_sort import _on_tpu
 
+        dt = jnp.dtype(keys.dtype)
+        wide_int = dt.itemsize == 8 and not jnp.issubdtype(dt, jnp.floating)
         kernel = (
             "block"
             if (
                 keys.ndim == 1
-                and jnp.dtype(keys.dtype).itemsize == 4
+                and (dt.itemsize == 4 or wide_int)
                 and keys.shape[0] >= _AUTO_BLOCK_MIN
                 and _on_tpu()
             )
@@ -119,8 +121,8 @@ def sort_with_kernel(keys: jax.Array, kernel: str = "auto") -> jax.Array:
     if kernel == "lax":
         return sort_keys(keys)
     if kernel == "block":
-        if jnp.dtype(keys.dtype).itemsize == 8:
-            return sort_keys(keys)  # Mosaic is 32-bit; lax covers wide keys
+        if jnp.issubdtype(keys.dtype, jnp.floating) and jnp.dtype(keys.dtype).itemsize == 8:
+            return sort_keys(keys)  # f64 maps via float_order in the pipelines
         from dsort_tpu.ops.block_sort import block_sort
 
         return block_sort(keys)

@@ -86,3 +86,23 @@ def test_block_sort_rejects_bad_block_rows():
         block_sort(x, block_rows=300, interpret=True)
     with pytest.raises(ValueError):
         block_sort(x, tile_rows=4, interpret=True)
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.uint64])
+def test_block_sort_64bit_planes(dtype):
+    """64-bit keys ride as lexicographic (hi, lo) uint32 planes."""
+    rng = np.random.default_rng(9)
+    lo = 0 if dtype == np.uint64 else -(2**62)
+    x = rng.integers(lo, 2**62, 30_000).astype(dtype)
+    out = np.asarray(block_sort(jnp.asarray(x), block_rows=64, tile_rows=8, interpret=True))
+    np.testing.assert_array_equal(out, np.sort(x))
+
+
+def test_block_sort_64bit_hi_plane_collisions():
+    """Keys equal in the hi plane order by the lo plane."""
+    rng = np.random.default_rng(10)
+    x = ((rng.integers(0, 3, 20_000).astype(np.uint64)) << 32) | rng.integers(
+        0, 2**32, 20_000
+    ).astype(np.uint64)
+    out = np.asarray(block_sort(jnp.asarray(x), block_rows=64, tile_rows=8, interpret=True))
+    np.testing.assert_array_equal(out, np.sort(x))
