@@ -169,7 +169,7 @@ class ExternalSort:
         run_elems: int = 1 << 22,
         spill_dir: str | None = None,
         job_id: str = "external",
-        local_kernel: str = "lax",
+        local_kernel: str = "auto",
         resume: bool = True,
     ):
         if run_elems < 2:

@@ -394,7 +394,7 @@ def _vmem(rows):
     return pl.BlockSpec((rows, LANES), lambda g: (g, 0), memory_space=pltpu.VMEM)
 
 
-def _smem_scalar(ngrid=1):
+def _smem_scalar():
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -479,8 +479,6 @@ def _cross(xs, k_over_b, rows: int, m: int, interpret: bool):
             out_specs=tuple([pair_spec] * len(xs)),
             interpret=interpret,
         )(k_over_b, *x5)
-    if len(xs) == 1:
-        out = (out,) if not isinstance(out, (tuple, list)) else out
     return tuple(o.reshape(xs[0].shape) for o in out)
 
 
@@ -527,8 +525,7 @@ def _merge_tail(xs, k_over_b, rows: int, interpret: bool):
 
 
 def _as_tuple(out, nplanes):
-    if nplanes == 1 and not isinstance(out, (tuple, list)):
-        return (out,)
+    del nplanes  # pallas_call with a tuple out_shape always returns a tuple
     return tuple(out)
 
 
@@ -583,6 +580,11 @@ def block_sort(
     merge-block height and ``tile_rows`` the K1 tile height (tune only for
     experiments/tests; both must be powers of two >= 8).
     """
+    if x.ndim != 1:
+        raise ValueError(
+            f"block_sort takes a 1-D array, got shape {x.shape}; batched "
+            "sorts go through ops.local_sort.sort_keys"
+        )
     n = x.shape[0]
     if n <= 1:
         return x

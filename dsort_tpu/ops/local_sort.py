@@ -107,12 +107,16 @@ def sort_with_kernel(keys: jax.Array, kernel: str = "auto") -> jax.Array:
         from dsort_tpu.ops.pallas_sort import _on_tpu
 
         dt = jnp.dtype(keys.dtype)
-        wide_int = dt.itemsize == 8 and not jnp.issubdtype(dt, jnp.floating)
+        # Floats stay on lax: the comparator network's min/max would corrupt
+        # an order containing NaNs, and `auto` cannot know the array is
+        # NaN-free.  Framework float pipelines pre-map via ops.float_order
+        # to uints and so still reach the block kernel.
         kernel = (
             "block"
             if (
                 keys.ndim == 1
-                and (dt.itemsize == 4 or wide_int)
+                and dt.itemsize in (4, 8)
+                and not jnp.issubdtype(dt, jnp.floating)
                 and keys.shape[0] >= _AUTO_BLOCK_MIN
                 and _on_tpu()
             )

@@ -106,3 +106,38 @@ def test_block_sort_64bit_hi_plane_collisions():
     ).astype(np.uint64)
     out = np.asarray(block_sort(jnp.asarray(x), block_rows=64, tile_rows=8, interpret=True))
     np.testing.assert_array_equal(out, np.sort(x))
+
+
+def test_block_sort_64bit_deep_cross_levels():
+    """Enough blocks (t=64 at block_rows=8) that the multi-plane K2 path
+    (single cross stages at m > MULTI_M_HI) executes, not just K2b/K3."""
+    rng = np.random.default_rng(11)
+    x = rng.integers(-(2**62), 2**62, 40_000).astype(np.int64)
+    out = np.asarray(block_sort(jnp.asarray(x), block_rows=8, tile_rows=8, interpret=True))
+    np.testing.assert_array_equal(out, np.sort(x))
+
+
+def test_block_sort_rejects_2d():
+    with pytest.raises(ValueError, match="1-D"):
+        block_sort(jnp.zeros((64, 128), jnp.int32), interpret=True)
+
+
+def test_auto_kernel_keeps_floats_on_lax(monkeypatch):
+    """auto must never hand raw floats (possible NaNs) to the min/max network."""
+    import dsort_tpu.ops.pallas_sort as ps
+
+    monkeypatch.setattr(ps, "_on_tpu", lambda: True)
+    called = {}
+    import dsort_tpu.ops.block_sort as bs
+
+    def no_block(*a, **k):
+        called["block"] = True
+        raise AssertionError("block kernel must not see floats via auto")
+
+    monkeypatch.setattr(bs, "block_sort", no_block)
+    x = np.full(1 << 16, np.nan, np.float32)
+    x[:100] = np.arange(100, dtype=np.float32)
+    out = np.asarray(sort_with_kernel(jnp.asarray(x), "auto"))
+    assert "block" not in called
+    assert (out[:100] == np.arange(100, dtype=np.float32)).all()
+    assert np.isnan(out[100:]).all()
