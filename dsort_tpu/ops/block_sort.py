@@ -620,6 +620,18 @@ def block_sort(
         )
         return _from_ordered_unsigned(u, dtype)[:n]
 
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        # Mosaic has no unsigned vector min/max (arith.minui fails to
+        # legalize); ride the signed fast path via the order-preserving
+        # sign-bit flip.  (The 64-bit plane path is unaffected: it compares
+        # with `<`, which legalizes for unsigned.)
+        top = dtype.type(1 << (dtype.itemsize * 8 - 1))
+        signed = jnp.dtype(f"int{dtype.itemsize * 8}")
+        s = jax.lax.bitcast_convert_type(xp ^ top, signed)
+        (out,) = _sort_planes(
+            (s.reshape(-1, LANES),), p, block_rows, tile_rows, interpret
+        )
+        return jax.lax.bitcast_convert_type(out.reshape(-1)[:n], dtype) ^ top
     (out,) = _sort_planes(
         (xp.reshape(-1, LANES),), p, block_rows, tile_rows, interpret
     )

@@ -109,7 +109,12 @@ class Scheduler:
 
         Runs in a daemon thread so a hung attempt (which can't be killed) is
         abandoned rather than blocking process exit; the reference cannot
-        detect a hung worker at all.
+        detect a hung worker at all.  Known limitation (documented, accepted):
+        an abandoned attempt's thread still holds its device until the hung
+        call returns, so a *second* hang on the worker a shard was reassigned
+        to serializes behind the first; the timeout fires again and the shard
+        moves on, at added latency.  The worker is marked dead either way, so
+        no new shards land on a hung device.
         """
         box: dict = {}
         done = threading.Event()
