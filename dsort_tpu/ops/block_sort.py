@@ -73,7 +73,7 @@ from dsort_tpu.ops.pallas_sort import _on_tpu
 LANES = 128
 TILE_ROWS = 256  # K1 unit: 2^15 elements, 120 fused stages
 BLOCK_ROWS = 1024  # merge-block unit: 2^17 elements = 512 KiB int32
-MULTI_M_HI = 8  # K2b fuses cross distances of 2..8 blocks in one span pass
+MULTI_M_HI = 16  # K2b fuses cross distances of 2..16 blocks in one span pass
 
 
 def _lex_lt(a: tuple, b: tuple):
@@ -501,7 +501,7 @@ def _multi_cross(xs, k_over_b, rows: int, m_hi: int, interpret: bool):
             grid=(t,),
             in_specs=[_smem_scalar()] + [spec] * len(xs),
             out_specs=tuple([spec] * len(xs)),
-            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 << 20),
             interpret=interpret,
         )(k_over_b, *xs)
     return out
