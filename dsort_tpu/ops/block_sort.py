@@ -12,15 +12,16 @@ has ~300 stages, but only ~20 of them have an exchange distance that crosses
 a merge-block boundary.  The pass structure:
 
 - **K1 (tile sort, column-major)**: one grid pass fully sorts each
-  ``(256, 128)`` VMEM tile — 120 stages fused.  The tile's flat element
+  ``(1024, 128)`` VMEM tile — 153 stages fused.  The tile's flat element
   order is column-major during the sort (``t = lane*rows + row``), which
   turns 84 would-be lane exchanges into cheap row exchanges; one in-kernel
   content transpose at the end restores row-major flat order.  Directions
   come from the *global* element index, so tile ``t`` emerges ascending iff
   ``t`` is even: the bitonic precondition for every merge level above.
 - **K1b (level combiner)**: merge levels whose span still fits a VMEM block
-  run as one fused pass per 4x block widening (at the defaults: one pass,
-  levels 2^16..2^17 on 1024-row blocks).
+  run as one fused pass per 4x block widening (a no-op at the defaults,
+  where the K1 tile already spans the full merge block; exercised by tests
+  and non-default tile/block configurations).
 - **K2 (cross stage)**: for exchange distances of ``m > MULTI_M_HI`` blocks,
   each grid step owns a whole pair via a ``(pairs, 2, m, rows, 128)`` view
   (one strided rectangular DMA per side) and writes both members — 2n bytes
@@ -32,8 +33,8 @@ a merge-block boundary.  The pass structure:
   then finishes BOTH halves' intra-block stages in VMEM before writing once.
 
 K2/K2b/K3 take the merge level as an SMEM scalar, so one compilation serves
-every level.  Total HBM passes for 2^24 at the defaults: 1 (K1) + 1 (K1b) +
-6 (K2b) + 6 (K2) + 7 (K3) = 21, vs ~250 for ``lax.sort``.
+every level.  Total HBM passes for 2^24 at the defaults: 1 (K1) +
+6 (K2b) + 2 (K2) + 7 (K3) = 16, vs ~250 for ``lax.sort``.
 
 Exchange formulations are chosen per distance from on-chip microbenchmarks:
 vreg-aligned row distances (j >= 8) use a pair view ``(pairs, 2, j, 128)``
@@ -71,7 +72,7 @@ from dsort_tpu.ops.local_sort import sentinel_for
 from dsort_tpu.ops.pallas_sort import _on_tpu
 
 LANES = 128
-TILE_ROWS = 256  # K1 unit: 2^15 elements, 120 fused stages
+TILE_ROWS = 1024  # K1 unit: 2^17 elements, 153 fused stages (one pass, no K1b at defaults)
 BLOCK_ROWS = 1024  # merge-block unit: 2^17 elements = 512 KiB int32
 MULTI_M_HI = 16  # K2b fuses cross distances of 2..16 blocks in one span pass
 
