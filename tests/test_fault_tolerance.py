@@ -373,3 +373,24 @@ def test_spmd_shuffle_resume_with_duplicate_boundary_keys(mesh8, tmp_path):
     out = sched.sort(data, metrics=m, job_id="dupjob")
     np.testing.assert_array_equal(out, np.sort(data))
     assert m.counters["shuffle_ranges_restored"] >= 1
+
+
+def test_spmd_shuffle_resume_two_nonadjacent_gaps(mesh8, tmp_path):
+    """Losing two non-adjacent ranges reconstructs both intervals by value."""
+    from dsort_tpu.checkpoint import ShardCheckpoint
+
+    job = JobConfig(settle_delay_s=0.01, checkpoint_dir=str(tmp_path))
+    sched = SpmdScheduler(job=job)
+    data = gen_uniform(40_000, seed=70)
+    out1 = sched.sort(data, job_id="gapjob")
+    # Simulate a partially-lost shuffle: delete ranges 2 and 5 from disk.
+    ckpt = ShardCheckpoint(str(tmp_path), "gapjob")
+    import os
+
+    os.remove(ckpt._range_path(2))
+    os.remove(ckpt._range_path(5))
+    m = Metrics()
+    out2 = sched.sort(data, metrics=m, job_id="gapjob")
+    np.testing.assert_array_equal(out2, out1)
+    assert m.counters["shuffle_ranges_restored"] == 6
+    assert 0 < m.counters["shuffle_resort_keys"] < len(data)
