@@ -74,7 +74,7 @@ from dsort_tpu.ops.pallas_sort import _on_tpu
 LANES = 128
 TILE_ROWS = 1024  # K1 unit: 2^17 elements, 153 fused stages (one pass, no K1b at defaults)
 BLOCK_ROWS = 1024  # merge-block unit: 2^17 elements = 512 KiB int32
-MULTI_M_HI = 16  # K2b fuses cross distances of 2..16 blocks in one span pass
+SPAN_M_HI = 8  # the span-tail pass covers cross distances 2..8 + the tail
 
 
 def _lex_lt(a: tuple, b: tuple):
@@ -89,15 +89,21 @@ def _lex_lt(a: tuple, b: tuple):
     return lt
 
 
-def _exchange_rows(xs: tuple, j: int, asc) -> tuple:
+def _exchange_rows(xs: tuple, j: int, asc, active=None) -> tuple:
     """Compare-exchange at row distance ``j`` (flat distance ``j * 128``).
 
     Pairs ``(i, i ^ j*128)`` are the two middle-axis slices of a
     ``(rows/2j, 2, j, 128)`` view — no rolls, and the comparison is computed
     once per *pair* instead of once per element.  ``asc`` broadcasts against
-    the ``(rows/2j, j, 128)`` half view (scalar or ``(rows/2j, 1, 1)`` mask).
+    the ``(rows/2j, j, 128)`` half view: scalar, ``(rows/2j, 1, 1)`` mask,
+    or a per-row ``(rows, 1)`` mask (reshaped here; must be constant across
+    each pair's j rows).  ``active`` (traced scalar) turns the whole stage
+    into a predicated no-op — used by the span-tail kernel, whose stage list
+    is static but whose merge level arrives at runtime.
     """
     rows = xs[0].shape[0]
+    if getattr(asc, "ndim", 0) == 2:  # per-row mask -> pair view
+        asc = asc.reshape(rows // (2 * j), 2, j, 1)[:, 0]
     views = [x.reshape(rows // (2 * j), 2, j, LANES) for x in xs]
     a = tuple(v[:, 0] for v in views)
     b = tuple(v[:, 1] for v in views)
@@ -106,15 +112,19 @@ def _exchange_rows(xs: tuple, j: int, asc) -> tuple:
         out = jnp.stack(
             [jnp.where(asc, lo, hi), jnp.where(asc, hi, lo)], axis=1
         )
-        return (out.reshape(rows, LANES),)
-    take_a = _lex_lt(a, b) == asc  # a stays first iff (a<b) matches direction
-    outs = []
-    for ap, bp in zip(a, b):
-        out = jnp.stack(
-            [jnp.where(take_a, ap, bp), jnp.where(take_a, bp, ap)], axis=1
-        )
-        outs.append(out.reshape(rows, LANES))
-    return tuple(outs)
+        outs = (out.reshape(rows, LANES),)
+    else:
+        take_a = _lex_lt(a, b) == asc  # a first iff (a<b) matches direction
+        outs = []
+        for ap, bp in zip(a, b):
+            out = jnp.stack(
+                [jnp.where(take_a, ap, bp), jnp.where(take_a, bp, ap)], axis=1
+            )
+            outs.append(out.reshape(rows, LANES))
+        outs = tuple(outs)
+    if active is not None:  # predicated no-op when this stage's m > level's
+        outs = tuple(jnp.where(active, o, x) for o, x in zip(outs, xs))
+    return outs
 
 
 def _exchange_rows_roll(xs: tuple, j: int, asc) -> tuple:
@@ -337,53 +347,37 @@ def _cross_kernel(k_ref, *refs, m: int, np_: int):
         o[0, 1, 0] = jnp.where(take_a, bp, ap)
 
 
-def _multi_cross_kernel(k_ref, *refs, rows: int, m_hi: int, np_: int):
-    """K2b: cross stages at block distances ``m_hi, m_hi/2, .., 2`` fused.
+def _span_tail_kernel(k_ref, *refs, rows: int, m_hi: int, np_: int):
+    """K2b+K3 fused: cross distances ``m_hi..2`` (runtime-predicated), the
+    distance-one-block stage, and every block's intra-block merge tail — one
+    pass finishes a whole merge level for levels with ``m_max <= m_hi``.
 
-    One grid step owns a *span* of ``2 * m_hi`` blocks, inside which every
-    pair for those distances is local: each stage is a vreg-aligned row
-    exchange (pair view) at ``j = m * rows`` — so a span pass replaces
-    log2(m_hi) separate bandwidth passes with one.
+    One grid step owns a span of ``2 * m_hi`` blocks.  The merge level
+    arrives as an SMEM scalar (``kb = k/B``), so one compilation serves all
+    levels: a cross stage at block distance ``m`` exists iff ``kb >= 2m``
+    and is otherwise a predicated no-op.  Directions are per block
+    (``(blk & kb) == 0`` as a per-row mask): constant across every exchange
+    pair, since pairs at distance m share the kb bit (kb >= 2m) and
+    sub-block pairs sit inside one block.
     """
     import jax.experimental.pallas as pl
 
     span = 2 * m_hi
     xs = tuple(r[:] for r in refs[:np_])
     kb = k_ref[0, 0]
-    # Block index of every row in the span (global): span_start + local.
-    rowi = jax.lax.broadcasted_iota(jnp.int32, (span * rows, 1), 0)
-    blk = pl.program_id(0) * span + rowi // rows
-    asc_rows = (blk & kb) == 0  # (span*rows, 1), constant across the level
+    rowi_span = jax.lax.broadcasted_iota(jnp.int32, (span * rows, 1), 0)
+    blk = pl.program_id(0) * span + rowi_span // rows
+    asc_rows = (blk & kb) == 0  # (span*rows, 1), constant per block
     m = m_hi
     while m >= 2:
-        j = m * rows
-        asc = asc_rows.reshape(span * rows // (2 * j), 2, j, 1)[:, 0]
-        xs = _exchange_rows(xs, j, asc)
+        xs = _exchange_rows(xs, m * rows, asc_rows, active=kb >= 2 * m)
         m //= 2
-    for o_ref, x in zip(refs[np_:], xs):
-        o_ref[:] = x
-
-
-def _merge_tail_kernel(k_ref, *refs, rows: int, np_: int):
-    """K3: distance-one-block stage + all intra-block stages, fused.
-
-    One grid step owns a contiguous block *pair* (2*rows, 128): it applies
-    the distance-one-block exchange (a row exchange at ``j = rows``), then
-    finishes the bitonic merge of BOTH blocks in VMEM — every sub-block
-    stage distance stays inside its own j-aligned group, so running the
-    helpers on the doubled-height array merges the halves independently.
-    2n bytes moved; both halves share the direction bit (k/B >= 2).
-    """
-    import jax.experimental.pallas as pl
-
-    g = pl.program_id(0)
-    asc = ((2 * g) & k_ref[0, 0]) == 0
-    xs = tuple(r[:] for r in refs[:np_])
-    xs = _exchange_rows(xs, rows, asc)  # the distance-B stage
-    lane = jax.lax.broadcasted_iota(jnp.int32, (2 * rows, LANES), 1)
-    rowi = jax.lax.broadcasted_iota(jnp.int32, (2 * rows, LANES), 0)
-    # Remaining distances rows*LANES/2 .. 1 on both halves at once.
-    xs = _level_stages(xs, rows * LANES, 2 * rows, lane, rowi, asc_top=asc)
+    xs = _exchange_rows(xs, rows, asc_rows)  # distance-one-block stage
+    lane = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 1)
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 0)
+    # Intra-block distances rows*LANES/2 .. 1 for all blocks of the span.
+    xs = _level_stages(xs, rows * LANES, span * rows, lane, rowi,
+                       asc_top=asc_rows)
     for o_ref, x in zip(refs[np_:], xs):
         o_ref[:] = x
 
@@ -484,7 +478,7 @@ def _cross(xs, k_over_b, rows: int, m: int, interpret: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("rows", "m_hi", "interpret"))
-def _multi_cross(xs, k_over_b, rows: int, m_hi: int, interpret: bool):
+def _span_tail(xs, k_over_b, rows: int, m_hi: int, interpret: bool):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -496,30 +490,13 @@ def _multi_cross(xs, k_over_b, rows: int, m_hi: int, interpret: bool):
     with jax.enable_x64(False):  # see _tile_sort_cm
         out = pl.pallas_call(
             functools.partial(
-                _multi_cross_kernel, rows=rows, m_hi=m_hi, np_=len(xs)
+                _span_tail_kernel, rows=rows, m_hi=m_hi, np_=len(xs)
             ),
             out_shape=_shapes(xs),
             grid=(t,),
             in_specs=[_smem_scalar()] + [spec] * len(xs),
             out_specs=tuple([spec] * len(xs)),
             compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 << 20),
-            interpret=interpret,
-        )(k_over_b, *xs)
-    return out
-
-
-@functools.partial(jax.jit, static_argnames=("rows", "interpret"))
-def _merge_tail(xs, k_over_b, rows: int, interpret: bool):
-    import jax.experimental.pallas as pl
-
-    t = xs[0].shape[0] // rows
-    with jax.enable_x64(False):  # see _tile_sort_cm
-        out = pl.pallas_call(
-            functools.partial(_merge_tail_kernel, rows=rows, np_=len(xs)),
-            out_shape=_shapes(xs),
-            grid=(t // 2,),
-            in_specs=[_smem_scalar()] + [_vmem(2 * rows)] * len(xs),
-            out_specs=tuple([_vmem(2 * rows)] * len(xs)),
             interpret=interpret,
         )(k_over_b, *xs)
     return out
@@ -551,17 +528,20 @@ def _sort_planes(
         blk = target
     b = blk * LANES
 
-    # K2/K2b/K3 cross-block merge levels.
+    # K2 (single cross passes above the span) + K2b/K3 fused span-tail:
+    # one pass finishes each merge level whose remaining distances fit the
+    # span.  Wider (multi-plane) keys use a smaller span to stay in VMEM.
+    span_m_hi = SPAN_M_HI if nplanes == 1 else SPAN_M_HI // 2
+    t_blocks = total_rows // blk
+    span_m = max(min(span_m_hi, t_blocks // 2), 1)
     k = 2 * b
     while k <= p:
         kb = jnp.full((1, 1), k // b, jnp.int32)
         m = k // (2 * b)
-        while m > MULTI_M_HI:
+        while m > span_m:
             xs = _as_tuple(_cross(xs, kb, blk, m, interpret), nplanes)
             m //= 2
-        if m >= 2:
-            xs = _as_tuple(_multi_cross(xs, kb, blk, m, interpret), nplanes)
-        xs = _as_tuple(_merge_tail(xs, kb, blk, interpret), nplanes)
+        xs = _as_tuple(_span_tail(xs, kb, blk, span_m, interpret), nplanes)
         k *= 2
     return xs
 
