@@ -30,6 +30,15 @@ class ShardCheckpoint:
         self.dir = os.path.join(root, job_id)
         os.makedirs(self.dir, exist_ok=True)
         self._manifest_path = os.path.join(self.dir, "manifest.json")
+        # A crash between np.save and os.replace leaves a '*.tmp.npy' (or
+        # 'manifest.json.tmp') behind; sweep them here so a torn write can
+        # never break listing/resume for this job_id (ADVICE r2).
+        for name in os.listdir(self.dir):
+            if ".tmp" in name:
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
 
     def _shard_path(self, shard_id: int) -> str:
         return os.path.join(self.dir, f"shard_{shard_id:05d}.npy")
@@ -71,7 +80,8 @@ class ShardCheckpoint:
     def completed_shards(self) -> list[int]:
         out = []
         for name in os.listdir(self.dir):
-            if name.startswith("shard_") and name.endswith(".npy"):
+            if (name.startswith("shard_") and name.endswith(".npy")
+                    and ".tmp" not in name):
                 out.append(int(name[len("shard_"):-len(".npy")]))
         return sorted(out)
 
@@ -97,9 +107,18 @@ class ShardCheckpoint:
     def completed_ranges(self) -> list[int]:
         out = []
         for name in os.listdir(self.dir):
-            if name.startswith("range_") and name.endswith(".npy"):
+            if (name.startswith("range_") and name.endswith(".npy")
+                    and ".tmp" not in name):
                 out.append(int(name[len("range_"):-len(".npy")]))
         return sorted(out)
+
+    def clear_ranges(self) -> None:
+        """Drop the shuffle-phase ranges only (local-sort shards survive)."""
+        for i in self.completed_ranges():
+            try:
+                os.remove(self._range_path(i))
+            except OSError:
+                pass
 
     def clear(self) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)

@@ -41,10 +41,15 @@ _DEVICE_ERROR_PREFIXES = (
     "INTERNAL",
     "UNAVAILABLE",
     "ABORTED",
-    "CANCELLED",
     "DATA_LOSS",
     "DEADLINE_EXCEEDED",
 )
+
+#: Statuses XLA commonly reports for work cancelled *secondarily* (a sibling
+#: computation failed, or host-side cancellation) — the device underneath is
+#: usually healthy, so these retry (bounded / probe-gated) rather than mark
+#: the worker dead outright (ADVICE r2).
+_TRANSIENT_ERROR_PREFIXES = ("CANCELLED",)
 
 
 def _runtime_error_types() -> tuple[type, ...]:
@@ -64,20 +69,34 @@ def _runtime_error_types() -> tuple[type, ...]:
     return tuple(types)
 
 
-def is_device_runtime_error(exc: BaseException) -> bool:
-    """True iff ``exc`` is a JAX/XLA runtime error that signals device loss.
+def classify_runtime_error(exc: BaseException) -> str | None:
+    """Classify a JAX/XLA runtime error: ``"device"`` | ``"transient"`` | None.
 
     Used by both schedulers to route *real* runtime failures (not just the
-    test injector's `WorkerFailure`) into mark-dead + reassign/re-form.
-    Classification is by the gRPC-style status prefix of the message
-    (``"INTERNAL: ..."`` etc.); anything not on the allowlist propagates to
-    the caller as a genuine error.
+    test injector's `WorkerFailure`) into recovery.  Classification is by the
+    gRPC-style status prefix of the message (``"INTERNAL: ..."`` etc.):
+
+    - ``"device"``: the device/runtime itself died — mark dead, reassign or
+      re-form the mesh;
+    - ``"transient"``: likely secondary cancellation (CANCELLED) — retry the
+      same worker a bounded number of times (task-pool) or probe-then-decide
+      (SPMD) before escalating to device death;
+    - ``None``: a genuine program error — propagates to the caller.
     """
     types = _runtime_error_types()
     if not types or not isinstance(exc, types):
-        return False
+        return None
     msg = str(exc).lstrip()
-    return msg.startswith(_DEVICE_ERROR_PREFIXES)
+    if msg.startswith(_DEVICE_ERROR_PREFIXES):
+        return "device"
+    if msg.startswith(_TRANSIENT_ERROR_PREFIXES):
+        return "transient"
+    return None
+
+
+def is_device_runtime_error(exc: BaseException) -> bool:
+    """True iff ``exc`` is a runtime error that signals outright device loss."""
+    return classify_runtime_error(exc) == "device"
 
 
 class FaultInjector:

@@ -43,7 +43,7 @@ from dsort_tpu.scheduler.fault import (
     FaultInjector,
     JobFailedError,
     WorkerFailure,
-    is_device_runtime_error,
+    classify_runtime_error,
 )
 from dsort_tpu.scheduler.liveness import WorkerTable
 from dsort_tpu.utils.logging import get_logger
@@ -151,6 +151,7 @@ class Scheduler:
             metrics.bump("shards_restored")
             return
         worker = i if self.table.is_alive(i) else -1
+        transient_left = self.job.max_transient_retries
         while True:
             if worker < 0 or not self.table.is_alive(worker):
                 worker = self.table.first_live()
@@ -162,9 +163,23 @@ class Scheduler:
                     ckpt.save(i, results[i])
                 return  # result pinned to slot i (server.c:415)
             except Exception as e:
+                kind = classify_runtime_error(e)
                 if isinstance(e, (WorkerFailure, TimeoutError)):
                     stage = getattr(e, "stage", "timeout")
-                elif is_device_runtime_error(e):
+                elif kind == "transient" and transient_left > 0:
+                    # Likely a secondary cancellation (CANCELLED): the device
+                    # underneath is usually healthy — retry the SAME worker a
+                    # bounded number of times before treating it as death.
+                    transient_left -= 1
+                    metrics.bump("transient_retries")
+                    log.warning(
+                        "transient runtime error on worker %d shard %d "
+                        "(retries left %d): %s",
+                        worker, i, transient_left, str(e).splitlines()[0][:120],
+                    )
+                    time.sleep(self.job.settle_delay_s)
+                    continue
+                elif kind is not None:
                     # A *real* XLA runtime failure from the device — the
                     # send()/recv()<=0 analogue (server.c:358,421-448) — is
                     # handled exactly like an injected failure.  Anything
@@ -440,6 +455,21 @@ class SpmdScheduler:
                 f"shuffle resume reconstructed {len(out)} of {len(work)} "
                 "keys; clearing the checkpoint and re-running is required"
             )
+        # Persist the recovered result so the NEXT run of this job_id takes
+        # the full-restore path instead of repeating the subset re-sort
+        # (ADVICE r2).  Write order is crash-safe: clearing first means a
+        # crash mid-rewrite leaves either no ranges (full re-shuffle) or a
+        # single all-covering range (resume re-derives an empty subset).
+        man = ckpt.manifest() or {}
+        ckpt.clear_ranges()
+        ckpt.save_range(0, out)
+        ckpt.write_manifest(
+            man.get("num_shards", len(self.devices)),
+            work.dtype,
+            man.get("total", len(work)),
+            fingerprint=man.get("fingerprint"),
+            n_ranges=1,
+        )
         return out
 
     def sort(
@@ -504,7 +534,16 @@ class SpmdScheduler:
             devs = [self.devices[i] for i in live]
             try:
                 if ckpt is not None:
-                    work = self._local_sort_phase(data, ckpt, metrics)
+                    # Full restore (every shuffle range on disk) never reads
+                    # `work`: skip the local-sort phase's full-dataset shard
+                    # restore — at 1B-key scale that is GBs of pointless IO.
+                    man0 = ckpt.manifest() or {}
+                    full_restore = (
+                        man0.get("n_ranges") is not None
+                        and len(ckpt.completed_ranges()) == man0["n_ranges"]
+                    )
+                    if not full_restore:
+                        work = self._local_sort_phase(data, ckpt, metrics)
                 # Injection point models a device lost in the shuffle phase —
                 # i.e. after the checkpointed local-sort phase boundary.
                 if self.injector is not None:
@@ -541,7 +580,9 @@ class SpmdScheduler:
                 # exception for the whole collective).  Probe to find which
                 # participant died; with every device healthy it was a
                 # transient fault — retry a bounded number of times.
-                if not is_device_runtime_error(e):
+                # "transient"-classified statuses (CANCELLED) take the same
+                # probe-then-decide path: only a failed probe kills a device.
+                if classify_runtime_error(e) is None:
                     raise
                 metrics.bump("device_runtime_errors")
                 dead = self._reap_after_runtime_error(live, metrics)

@@ -394,3 +394,109 @@ def test_spmd_shuffle_resume_two_nonadjacent_gaps(mesh8, tmp_path):
     np.testing.assert_array_equal(out2, out1)
     assert m.counters["shuffle_ranges_restored"] == 6
     assert 0 < m.counters["shuffle_resort_keys"] < len(data)
+
+
+# ---- ADVICE r2 fixes ----
+
+
+def test_cancelled_classifies_transient():
+    from dsort_tpu.scheduler.fault import (
+        classify_runtime_error,
+        is_device_runtime_error,
+    )
+
+    e = _xla_error("CANCELLED: sibling computation failed")
+    assert classify_runtime_error(e) == "transient"
+    assert not is_device_runtime_error(e)  # no longer unconditional death
+    assert classify_runtime_error(_xla_error("INTERNAL: halt")) == "device"
+    assert classify_runtime_error(ValueError("CANCELLED: not XLA")) is None
+
+
+def test_taskpool_cancelled_retries_same_worker(monkeypatch):
+    """CANCELLED retries on the same worker; it is NOT marked dead."""
+    sched = make_sched()
+    real = sched.executor.sort_shard
+    tripped = {}
+
+    def flaky(worker, data):
+        if worker == 1 and not tripped.get(1):
+            tripped[1] = True
+            raise _xla_error("CANCELLED: work cancelled by sibling failure")
+        return real(worker, data)
+
+    monkeypatch.setattr(sched.executor, "sort_shard", flaky)
+    data = gen_uniform(10_000, seed=21)
+    m = Metrics()
+    out = sched.run_job(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters["transient_retries"] == 1
+    assert "reassignments" not in m.counters
+    assert sched.table.is_alive(1)
+
+
+def test_taskpool_cancelled_escalates_after_budget(monkeypatch):
+    """Persistent CANCELLED on one worker escalates to reassignment."""
+    sched = Scheduler(DeviceExecutor(), JobConfig(
+        settle_delay_s=0.01, heartbeat_timeout_s=5.0, max_transient_retries=1
+    ))
+    real = sched.executor.sort_shard
+
+    def always_cancelled(worker, data):
+        if worker == 0:
+            raise _xla_error("CANCELLED: persistently cancelled")
+        return real(worker, data)
+
+    monkeypatch.setattr(sched.executor, "sort_shard", always_cancelled)
+    data = gen_uniform(10_000, seed=22)
+    m = Metrics()
+    out = sched.run_job(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters["transient_retries"] >= 1
+    assert m.counters["reassignments"] >= 1
+    assert not sched.table.is_alive(0)
+
+
+def test_checkpoint_ignores_torn_tmp_files(tmp_path):
+    """A crash between np.save and os.replace leaves '*.tmp.npy' files; they
+    must neither crash listing nor be served as results (ADVICE r2)."""
+    from dsort_tpu.checkpoint import ShardCheckpoint
+
+    ckpt = ShardCheckpoint(str(tmp_path), "torn")
+    ckpt.save(0, np.arange(4, dtype=np.int32))
+    ckpt.save_range(0, np.arange(4, dtype=np.int32))
+    import os
+
+    for name in ("shard_00001.npy.tmp.npy", "range_00001.npy.tmp.npy",
+                 "manifest.json.tmp"):
+        with open(os.path.join(ckpt.dir, name), "wb") as f:
+            f.write(b"torn")
+    assert ckpt.completed_shards() == [0]
+    assert ckpt.completed_ranges() == [0]
+    # a fresh handle (the next run) sweeps the torn leftovers
+    ckpt2 = ShardCheckpoint(str(tmp_path), "torn")
+    assert not any(".tmp" in n for n in os.listdir(ckpt2.dir))
+    assert ckpt2.completed_shards() == [0]
+
+
+def test_spmd_shuffle_resume_persists_recovery(mesh8, tmp_path):
+    """After a subset re-sort, the recovered result is persisted: the NEXT
+    run takes the full-restore path instead of repeating the re-sort."""
+    from dsort_tpu.checkpoint import ShardCheckpoint
+
+    job = JobConfig(settle_delay_s=0.01, checkpoint_dir=str(tmp_path))
+    sched = SpmdScheduler(job=job)
+    data = gen_uniform(40_000, seed=71)
+    out1 = sched.sort(data, job_id="persistjob")
+    ckpt = ShardCheckpoint(str(tmp_path), "persistjob")
+    import os
+
+    os.remove(ckpt._range_path(3))
+    m2 = Metrics()
+    out2 = sched.sort(data, metrics=m2, job_id="persistjob")
+    np.testing.assert_array_equal(out2, out1)
+    assert m2.counters["shuffle_resort_keys"] > 0
+    m3 = Metrics()
+    out3 = sched.sort(data, metrics=m3, job_id="persistjob")
+    np.testing.assert_array_equal(out3, out1)
+    assert m3.counters["shuffle_phase_restores"] == 1
+    assert "shuffle_resort_keys" not in m3.counters
