@@ -96,12 +96,22 @@ class JobConfig:
     merge_kernel: str = "sort"      # post-shuffle combine: "sort" | "bitonic"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
-    capacity_factor: float = 2.0    # per-(src,dst) all_to_all bucket headroom
+    # Per-(src,dst) all_to_all bucket headroom over the ideal n/P split.
+    # 1.3 suffices for oversample=32 splitters on uniform data; skewed data
+    # overflows once and the retry resizes from the MEASURED max bucket
+    # (sample_sort.cap_from_observed), so a blanket 2x tax — which doubled
+    # both the exchange bytes and the merge-phase work — is gone (VERDICT r2).
+    capacity_factor: float = 1.3
     max_capacity_retries: int = 3   # overflow → double capacity and retry
     # Fault tolerance (reference semantics, SURVEY.md §5.3, + heartbeat upgrade):
     max_reassign_attempts: int | None = None  # None → up to num_workers - 1
     settle_delay_s: float = 0.1     # reference's 100 ms usleep (server.c:304,391,446)
     heartbeat_timeout_s: float = 10.0  # fixes the reference's hang-blindness
+    # Extra first-attempt budget while a (shape, dtype, kernel) combo is
+    # cold: XLA/Mosaic compilation (30-150 s through a remote compiler) must
+    # not read as a hung worker.  Applies once per combo per scheduler; a
+    # genuinely hung worker on a cold shape is still detected, just slower.
+    compile_grace_s: float = 240.0
     max_transient_retries: int = 2  # real runtime error, all devices healthy
     checkpoint_dir: str | None = None  # persist sorted shards for partial recovery
 
@@ -162,14 +172,20 @@ class SortConfig:
             num_workers=geti("NUM_WORKERS", None),
             dp=geti("DP", 1),
         )
+        # Numeric fallbacks reference the dataclass defaults so a tuning
+        # there can never silently diverge from the conf-file path.
         job = JobConfig(
             key_dtype=jnp.dtype(m.get("KEY_DTYPE", "int32")),
             payload_bytes=geti("PAYLOAD_BYTES", 0),
-            local_kernel=m.get("LOCAL_KERNEL", "auto"),
-            merge_kernel=m.get("MERGE_KERNEL", "sort"),
-            oversample=geti("OVERSAMPLE", 32),
-            capacity_factor=float(m.get("CAPACITY_FACTOR", 2.0)),
-            heartbeat_timeout_s=float(m.get("HEARTBEAT_TIMEOUT_S", 10.0)),
+            local_kernel=m.get("LOCAL_KERNEL", JobConfig.local_kernel),
+            merge_kernel=m.get("MERGE_KERNEL", JobConfig.merge_kernel),
+            oversample=geti("OVERSAMPLE", JobConfig.oversample),
+            capacity_factor=float(
+                m.get("CAPACITY_FACTOR", JobConfig.capacity_factor)
+            ),
+            heartbeat_timeout_s=float(
+                m.get("HEARTBEAT_TIMEOUT_S", JobConfig.heartbeat_timeout_s)
+            ),
         )
         return cls(
             mesh=mesh,

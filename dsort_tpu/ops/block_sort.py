@@ -531,7 +531,7 @@ def _sort_planes(
     # K2 (single cross passes above the span) + K2b/K3 fused span-tail:
     # one pass finishes each merge level whose remaining distances fit the
     # span.  Wider (multi-plane) keys use a smaller span to stay in VMEM.
-    span_m_hi = SPAN_M_HI if nplanes == 1 else SPAN_M_HI // 2
+    span_m_hi = max(SPAN_M_HI // nplanes, 1)
     t_blocks = total_rows // blk
     span_m = max(min(span_m_hi, t_blocks // 2), 1)
     k = 2 * b
@@ -617,3 +617,69 @@ def block_sort(
         (xp.reshape(-1, LANES),), p, block_rows, tile_rows, interpret
     )
     return out.reshape(-1)[:n]
+
+
+def block_sort_pairs(
+    keys: jax.Array,
+    rank: jax.Array,
+    block_rows: int = BLOCK_ROWS,
+    tile_rows: int = TILE_ROWS,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Lexicographic ``(key, rank)`` ascending sort; returns both, permuted.
+
+    The key+payload combine of the distributed shuffle in one block-kernel
+    launch: ``rank`` (int32, typically ``is_pad * n + position``) both breaks
+    key ties deterministically and comes back as the gather permutation for
+    the payload.  Rides the same pass structure as `block_sort` with one
+    extra 32-bit plane; integer key dtypes only (the framework's float
+    pipelines pre-map via ``ops.float_order``).  Unsigned 32-bit keys need no
+    sign-flip here: the multi-plane network compares with ``<`` (which
+    legalizes for unsigned), not ``minui``.
+    """
+    if keys.ndim != 1 or rank.ndim != 1 or keys.shape != rank.shape:
+        raise ValueError(
+            f"block_sort_pairs takes equal-length 1-D arrays, got "
+            f"{keys.shape} and {rank.shape}"
+        )
+    dtype = jnp.dtype(keys.dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        raise ValueError(
+            "block_sort_pairs takes integer keys; map floats through "
+            "ops.float_order first (the framework pipelines already do)"
+        )
+    n = keys.shape[0]
+    if n <= 1:
+        return keys, rank.astype(jnp.int32)
+    if interpret is None:
+        interpret = not _on_tpu()
+    p = max(_ceil_pow2(n), 8 * LANES)
+    rank = rank.astype(jnp.int32)
+    kp, rp = keys, rank
+    if p != n:
+        # Pad ranks with int32 max so pads sort after any real entry whose
+        # key happens to equal the sentinel (real ranks are < 2^31 - 1).
+        kp = jnp.concatenate(
+            [keys, jnp.full(p - n, sentinel_for(dtype), dtype=dtype)]
+        )
+        rp = jnp.concatenate(
+            [rank, jnp.full(p - n, jnp.iinfo(jnp.int32).max, jnp.int32)]
+        )
+    rp = rp.reshape(-1, LANES)
+    if dtype.itemsize == 8:
+        from dsort_tpu.ops.radix import _from_ordered_unsigned, _to_ordered_unsigned
+
+        u = _to_ordered_unsigned(kp)
+        hi = (u >> 32).astype(jnp.uint32).reshape(-1, LANES)
+        lo = u.astype(jnp.uint32).reshape(-1, LANES)  # truncating cast
+        hi, lo, r = _sort_planes(
+            (hi, lo, rp), p, block_rows, tile_rows, interpret
+        )
+        u = (hi.reshape(-1).astype(jnp.uint64) << 32) | lo.reshape(-1).astype(
+            jnp.uint64
+        )
+        return _from_ordered_unsigned(u, dtype)[:n], r.reshape(-1)[:n]
+    k, r = _sort_planes(
+        (kp.reshape(-1, LANES), rp), p, block_rows, tile_rows, interpret
+    )
+    return k.reshape(-1)[:n], r.reshape(-1)[:n]

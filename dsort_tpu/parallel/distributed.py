@@ -97,13 +97,13 @@ def _build_mh_program(
     )
     if mode == "keys":
         fn = functools.partial(_sample_sort_shard, kernel=kernel, **kw)
-        n_in, n_out = 2, 3
+        n_in, n_out = 2, 4
     elif mode == "kv":
-        fn = functools.partial(_sample_sort_kv_shard, **kw)
-        n_in, n_out = 3, 4
+        fn = functools.partial(_sample_sort_kv_shard, kernel=kernel, **kw)
+        n_in, n_out = 3, 5
     else:  # kv2
-        fn = functools.partial(_sample_sort_kv2_shard, **kw)
-        n_in, n_out = 4, 5
+        fn = functools.partial(_sample_sort_kv2_shard, kernel=kernel, **kw)
+        n_in, n_out = 4, 6
     return jax.jit(
         jax.shard_map(
             fn,
@@ -218,21 +218,28 @@ def sort_local_shards(local_data, job=None, axis_name: str = "w", metrics=None):
 
     replicated = NamedSharding(mesh, P())
     any_overflow = jax.jit(jnp.any, out_shardings=replicated)
-    factor = job.capacity_factor
+    global_max = jax.jit(jnp.max, out_shardings=replicated)
+    cap_pair = _cap_pair_for(job.capacity_factor, cap, p_total)
     for _ in range(job.max_capacity_retries + 1):
-        cap_pair = _cap_pair_for(factor, cap, p_total)
         fn = _build_mh_program(
             mesh, axis_name, p_total, cap_pair, job.oversample,
             job.local_kernel, job.merge_kernel, "keys",
         )
         with timer.phase("spmd_sort"):
-            merged, out_counts, overflow = fn(xs, cj)
+            merged, out_counts, overflow, max_len = fn(xs, cj)
             ok = not bool(any_overflow(overflow))  # replicated: consistent
         if ok:
             break
         metrics.bump("capacity_retries")
-        factor *= 2.0
-        log.warning("multihost bucket overflow: retrying with factor=%.1f", factor)
+        # Lockstep-safe measured retry: the max bucket length reduces over
+        # the GLOBAL sharded output, so every process computes the same
+        # cap_pair (see sample_sort.next_cap_pair).
+        from dsort_tpu.parallel.sample_sort import next_cap_pair
+
+        observed = int(global_max(max_len))
+        cap_pair = next_cap_pair(observed, cap_pair, cap, p_total)
+        log.warning("multihost bucket overflow (max bucket %d): retrying with "
+                    "cap_pair=%d", observed, cap_pair)
     else:
         raise RuntimeError("sample sort bucket overflow after max retries")
 
@@ -300,9 +307,9 @@ def sort_local_records(
 
     replicated = NamedSharding(mesh, P())
     any_overflow = jax.jit(jnp.any, out_shardings=replicated)
-    factor = job.capacity_factor
+    global_max = jax.jit(jnp.max, out_shardings=replicated)
+    cap_pair = _cap_pair_for(job.capacity_factor, cap, p_total)
     for _ in range(job.max_capacity_retries + 1):
-        cap_pair = _cap_pair_for(factor, cap, p_total)
         fn = _build_mh_program(
             mesh, axis_name, p_total, cap_pair, job.oversample,
             job.local_kernel, job.merge_kernel,
@@ -310,15 +317,19 @@ def sort_local_records(
         )
         with timer.phase("spmd_sort"):
             if secondary is not None:
-                out_k, _, out_v, out_counts, overflow = fn(xs, sj, vs, cj)
+                out_k, _, out_v, out_counts, overflow, max_len = fn(xs, sj, vs, cj)
             else:
-                out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
+                out_k, out_v, out_counts, overflow, max_len = fn(xs, vs, cj)
             ok = not bool(any_overflow(overflow))
         if ok:
             break
         metrics.bump("capacity_retries")
-        factor *= 2.0
-        log.warning("multihost kv overflow: retrying with factor=%.1f", factor)
+        from dsort_tpu.parallel.sample_sort import next_cap_pair
+
+        observed = int(global_max(max_len))  # lockstep: global reduction
+        cap_pair = next_cap_pair(observed, cap_pair, cap, p_total)
+        log.warning("multihost kv overflow (max bucket %d): retrying with "
+                    "cap_pair=%d", observed, cap_pair)
     else:
         raise RuntimeError("sample sort bucket overflow after max retries")
 

@@ -56,6 +56,35 @@ def cap_pair_policy(n_local: int, factor: float, num_workers: int) -> int:
     return max(cap, 8)
 
 
+def cap_from_observed(max_len: int, n_local: int, num_workers: int) -> int:
+    """Retry capacity from a *measured* max bucket length, quantized.
+
+    The overflow retry used to blindly double ``capacity_factor``; the shard
+    program now reports its largest bucket, so one retry sizes the buffer to
+    exactly what the data needs (+5% headroom against nothing).  Quantizing
+    up to 1/8 of the ideal bucket size bounds the number of distinct
+    compiled programs a skewed workload can demand (<= ~9 steps between the
+    ideal and the ``n_local`` clamp) while wasting <= 12.5% padding —
+    the VERDICT r2 successor of the blanket 2.0x factor.
+    """
+    step = max(n_local // (8 * num_workers), 8)
+    cap = -(-int(max_len * 1.05 + 1) // step) * step
+    cap = min(-(-cap // 8) * 8, max(n_local, 8))
+    return max(cap, 8)
+
+
+def next_cap_pair(
+    observed: int, cap_pair: int, n_local: int, num_workers: int
+) -> int:
+    """The one overflow-retry resize rule, shared by every driver.
+
+    An overflow implies ``observed > cap_pair`` and ``cap_pair < n_local``,
+    so the measured resize is strictly larger; the ``max`` makes that
+    growth invariant explicit rather than trusted.
+    """
+    return max(cap_from_observed(observed, n_local, num_workers), cap_pair + 8)
+
+
 def _choose_splitters(xs_sorted, count, num_workers: int, oversample: int, axis: str):
     """Per-device samples -> all_gather -> P-1 global splitters (replicated)."""
     s = oversample
@@ -93,13 +122,14 @@ def _bucket_slices(xs_sorted, count, splitters, cap_pair: int):
     return jnp.clip(gidx, 0, max(n_local - 1, 0)), valid, lens, overflow
 
 
-def _merge_received(recv: jax.Array, merge_kernel: str) -> jax.Array:
+def _merge_received(recv: jax.Array, merge_kernel: str, kernel: str = "lax") -> jax.Array:
     """Combine the received (P, cap) buffer into one sorted (P*cap,) run.
 
     Each row arrives sorted with sentinel pads at its tail, so rows ARE
     sorted runs: "bitonic" merges them with an O(n log P) bitonic merge tree
-    (pure VPU work on TPU); "sort" re-sorts flat (O(n log n), but XLA's sort
-    is heavily tuned).  Both yield identical output.
+    (pure VPU work on TPU); "sort" re-sorts flat through the job's *local
+    kernel* dispatch (``sort_with_kernel``) — so a TPU mesh merges at block-
+    kernel speed, not lax speed (VERDICT r2).  Both yield identical output.
     """
     if merge_kernel == "bitonic":
         from dsort_tpu.ops.bitonic import _ceil_pow2, merge_sorted_runs
@@ -123,7 +153,9 @@ def _merge_received(recv: jax.Array, merge_kernel: str) -> jax.Array:
         # All valid keys sort ahead of the pads, so trimming to the original
         # total keeps every valid element and matches the "sort" path shape.
         return merge_sorted_runs(recv)[:out_len]
-    return sort_keys(recv.reshape(-1))
+    from dsort_tpu.ops.local_sort import sort_with_kernel
+
+    return sort_with_kernel(recv.reshape(-1), kernel)
 
 
 def _sample_sort_shard(
@@ -133,29 +165,44 @@ def _sample_sort_shard(
     """One device's view of the whole distributed sort (runs under shard_map).
 
     ``xs``: (n_local,) sentinel-padded keys; ``count``: (1,) valid length.
-    Returns (merged (P*cap_pair,), out_count (1,), overflow (1,)).
+    Returns (merged, out_count (1,), overflow (1,), max_len (1,)) where
+    ``max_len`` is the largest send-bucket length — the measurement the
+    host's capacity retry sizes the next buffer from.
+
+    ``num_workers == 1`` short-circuits after phase 1: the local sort IS the
+    answer, so the splitter/shuffle/merge phases (which would re-sort the
+    same array a second time) vanish from the compiled program entirely.
     """
     sent = sentinel_for(xs.dtype)
     count = count[0]
     xs, _ = sort_padded(xs, count, kernel)                           # phase 1
+    if num_workers == 1:
+        no = jnp.zeros((), bool)
+        return xs, count[None].astype(jnp.int32), no[None], count[None].astype(jnp.int32)
     splitters = _choose_splitters(xs, count, num_workers, oversample, axis)  # 2
     gidx, valid, lens, overflow = _bucket_slices(xs, count, splitters, cap_pair)  # 3
     send = jnp.where(valid, xs[gidx], sent)
     recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)       # 4
     lens_recv = jax.lax.all_to_all(lens[:, None], axis, split_axis=0, concat_axis=0)[:, 0]
-    merged = _merge_received(recv, merge_kernel)                             # 5
+    merged = _merge_received(recv, merge_kernel, kernel)                     # 5
     out_count = jnp.sum(lens_recv).astype(jnp.int32)
-    return merged, out_count[None], overflow[None]
+    return merged, out_count[None], overflow[None], jnp.max(lens)[None]
 
 
-def _merge_received_kv(flat_k, is_pad, num_workers: int, cap_pair: int, merge_kernel: str):
+def _merge_received_kv(
+    flat_k, is_pad, num_workers: int, cap_pair: int, merge_kernel: str,
+    kernel: str = "lax",
+):
     """Sorted permutation of the received kv buffer: (sorted keys, gather perm).
 
     Order is lexicographic on ``(key, is_pad, position)`` so real keys equal
     to the sentinel keep their payloads (no reserved key values).  "sort"
-    re-sorts flat via ``lax.sort``; "bitonic" exploits that each received row
-    is already a sorted run and merges them with the kv bitonic merge tree,
-    carrying ``is_pad * total + position`` as the tiebreak value.
+    re-sorts flat — through `ops.block_sort.block_sort_pairs` when the job's
+    local kernel resolves to the block kernel (the tiebreak value
+    ``is_pad * total + position`` rides as a second 32-bit plane and comes
+    back as the payload gather permutation), via ``lax.sort`` otherwise.
+    "bitonic" exploits that each received row is already a sorted run and
+    merges them with the kv bitonic merge tree, carrying the same tiebreak.
     """
     total = num_workers * cap_pair
     idx = jnp.arange(total, dtype=jnp.int32)
@@ -190,6 +237,14 @@ def _merge_received_kv(flat_k, is_pad, num_workers: int, cap_pair: int, merge_ke
         out_k, tieb_out = merged_k[:total], merged_t[:total]
         perm = jnp.where(tieb_out < total, tieb_out % total, 0)
         return out_k, perm
+    from dsort_tpu.ops.local_sort import resolve_kernel
+
+    if resolve_kernel(kernel, flat_k.dtype, total) == "block":
+        from dsort_tpu.ops.block_sort import block_sort_pairs
+
+        tieb = is_pad.astype(jnp.int32) * total + idx  # pads after every real
+        out_k, tieb_out = block_sort_pairs(flat_k, tieb)
+        return out_k, jnp.where(tieb_out < total, tieb_out, 0)
     is_pad8 = is_pad.astype(jnp.int8)
     out_k, _, perm = jax.lax.sort(
         (flat_k, is_pad8, idx), dimension=-1, num_keys=2, is_stable=False
@@ -199,7 +254,7 @@ def _merge_received_kv(flat_k, is_pad, num_workers: int, cap_pair: int, merge_ke
 
 def _kv_shard_body(
     keys, payload, sec, count, *, num_workers, oversample, cap_pair, axis,
-    merge_kernel="sort",
+    merge_kernel="sort", kernel="lax",
 ):
     """Shared kv shuffle body; ``sec`` is an optional (static) tiebreak array.
 
@@ -208,6 +263,9 @@ def _kv_shard_body(
     next to the payload (the combine then always uses ``lax.sort`` — the
     bitonic kv merge tree carries a single tiebreak channel, which the
     (is_pad, sec, position) triple would overflow).
+
+    ``num_workers == 1`` short-circuits after the local sort — the sorted
+    records ARE the answer; no splitters, no exchange, no second sort.
     """
     from dsort_tpu.ops.local_sort import _apply_perm, sort_kv2_padded, sort_kv_padded
 
@@ -221,6 +279,12 @@ def _kv_shard_body(
         keys, sec, payload, _ = sort_kv2_padded(
             keys, sec, payload, count, stable=False
         )
+    if num_workers == 1:
+        no = jnp.zeros((), bool)[None]
+        cnt = count[None].astype(jnp.int32)
+        if sec is None:
+            return keys, payload, cnt, no, cnt
+        return keys, sec, payload, cnt, no, cnt
     splitters = _choose_splitters(keys, count, num_workers, oversample, axis)
     gidx, valid, lens, overflow = _bucket_slices(keys, count, splitters, cap_pair)
     send_k = jnp.where(valid, keys[gidx], sent)
@@ -235,12 +299,13 @@ def _kv_shard_body(
     flat_k = jnp.where(is_pad, sent, recv_k.reshape(-1))
     flat_v = recv_v.reshape((-1,) + recv_v.shape[2:])
     out_count = jnp.sum(lens_recv).astype(jnp.int32)
+    max_len = jnp.max(lens)[None]
     if sec is None:
         out_k, perm = _merge_received_kv(
-            flat_k, is_pad, num_workers, cap_pair, merge_kernel
+            flat_k, is_pad, num_workers, cap_pair, merge_kernel, kernel
         )
         out_v = _apply_perm(flat_v, perm, 0)
-        return out_k, out_v, out_count[None], overflow[None]
+        return out_k, out_v, out_count[None], overflow[None], max_len
     recv_s = jax.lax.all_to_all(sec[gidx], axis, split_axis=0, concat_axis=0)
     idx = jnp.arange(num_workers * cap_pair, dtype=jnp.int32)
     out_k, _, out_s, perm = jax.lax.sort(
@@ -250,7 +315,7 @@ def _kv_shard_body(
         is_stable=False,
     )
     out_v = _apply_perm(flat_v, perm, 0)
-    return out_k, out_s, out_v, out_count[None], overflow[None]
+    return out_k, out_s, out_v, out_count[None], overflow[None], max_len
 
 
 def _sample_sort_kv_shard(keys, payload, count, **kw):
@@ -303,19 +368,21 @@ class SampleSort:
                 **kwargs,
             )
             in_specs = (P(self.axis), P(self.axis))
-            out_specs = (P(self.axis), P(self.axis), P(self.axis))
+            out_specs = (P(self.axis),) * 4
         elif secondary:
             fn = functools.partial(
-                _sample_sort_kv2_shard, merge_kernel=self.job.merge_kernel, **kwargs
+                _sample_sort_kv2_shard, merge_kernel=self.job.merge_kernel,
+                kernel=self.job.local_kernel, **kwargs
             )
             in_specs = (P(self.axis),) * 4
-            out_specs = (P(self.axis),) * 5
+            out_specs = (P(self.axis),) * 6
         else:
             fn = functools.partial(
-                _sample_sort_kv_shard, merge_kernel=self.job.merge_kernel, **kwargs
+                _sample_sort_kv_shard, merge_kernel=self.job.merge_kernel,
+                kernel=self.job.local_kernel, **kwargs
             )
             in_specs = (P(self.axis), P(self.axis), P(self.axis))
-            out_specs = (P(self.axis), P(self.axis), P(self.axis), P(self.axis))
+            out_specs = (P(self.axis),) * 5
         return jax.jit(
             jax.shard_map(
                 fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
@@ -368,21 +435,22 @@ class SampleSort:
             )
             cj = jax.device_put(jnp.asarray(counts), NamedSharding(self.mesh, P(self.axis)))
         n_local = shards.shape[1]
-        factor = self.job.capacity_factor
+        cap_pair = self._cap_pair(n_local, self.job.capacity_factor)
         for attempt in range(self.job.max_capacity_retries + 1):
-            cap_pair = self._cap_pair(n_local, factor)
             fn = self._build(n_local, cap_pair, None)
             with timer.phase("spmd_sort"):
-                merged, out_counts, overflow = fn(xs, cj)
+                merged, out_counts, overflow, max_len = fn(xs, cj)
                 merged.block_until_ready()
             if not bool(np.asarray(overflow).any()):
                 break
             metrics.bump("capacity_retries")
-            factor *= 2.0
+            # Size the retry from the measured max bucket (one retry
+            # converges: splitters are deterministic for the same data).
+            observed = int(np.asarray(max_len).max())
+            cap_pair = next_cap_pair(observed, cap_pair, n_local, p)
             log.warning(
-                "bucket overflow (attempt %d): retrying with capacity_factor=%.1f",
-                attempt + 1,
-                factor,
+                "bucket overflow (attempt %d, max bucket %d): retrying with "
+                "cap_pair=%d", attempt + 1, observed, cap_pair,
             )
         else:
             raise RuntimeError("sample sort bucket overflow after max retries")
@@ -440,22 +508,22 @@ class SampleSort:
                     jnp.asarray(ss).reshape(-1), NamedSharding(self.mesh, P(self.axis))
                 )
         n_local = sk.shape[1]
-        factor = self.job.capacity_factor
+        cap_pair = self._cap_pair(n_local, self.job.capacity_factor)
         for attempt in range(self.job.max_capacity_retries + 1):
-            cap_pair = self._cap_pair(n_local, factor)
             fn = self._build(
                 n_local, cap_pair, tuple(sv.shape[2:]), secondary is not None
             )
             with timer.phase("spmd_sort"):
                 if secondary is not None:
-                    out_k, _, out_v, out_counts, overflow = fn(xs, sj, vs, cj)
+                    out_k, _, out_v, out_counts, overflow, max_len = fn(xs, sj, vs, cj)
                 else:
-                    out_k, out_v, out_counts, overflow = fn(xs, vs, cj)
+                    out_k, out_v, out_counts, overflow, max_len = fn(xs, vs, cj)
                 out_k.block_until_ready()
             if not bool(np.asarray(overflow).any()):
                 break
             metrics.bump("capacity_retries")
-            factor *= 2.0
+            observed = int(np.asarray(max_len).max())
+            cap_pair = next_cap_pair(observed, cap_pair, n_local, p)
         else:
             raise RuntimeError("sample sort bucket overflow after max retries")
         with timer.phase("assemble"):
@@ -511,7 +579,7 @@ class BatchSampleSort:
                 step,
                 mesh=self.mesh,
                 in_specs=(P(self.dp_axis, self.axis),) * 2,
-                out_specs=(P(self.dp_axis, self.axis),) * 3,
+                out_specs=(P(self.dp_axis, self.axis),) * 4,
                 check_vma=False,
             )
         )
@@ -583,18 +651,19 @@ class BatchSampleSort:
             sharding = NamedSharding(self.mesh, P(self.dp_axis, self.axis))
             xs = jax.device_put(jnp.asarray(ks), sharding)
             cj = jax.device_put(jnp.asarray(cs), sharding)
-        factor = self.job.capacity_factor
+        cap_pair = cap_pair_policy(cap, self.job.capacity_factor, p)
         for _ in range(self.job.max_capacity_retries + 1):
-            cap_pair = cap_pair_policy(cap, factor, p)
             fn = self._build(cap, cap_pair)
             with timer.phase("spmd_sort"):
-                merged, out_counts, overflow = fn(xs, cj)
+                merged, out_counts, overflow, max_len = fn(xs, cj)
                 merged.block_until_ready()
             if not bool(np.asarray(overflow).any()):
                 break
             metrics.bump("capacity_retries")
-            factor *= 2.0
-            log.warning("batch overflow: retrying with larger capacity")
+            observed = int(np.asarray(max_len).max())
+            cap_pair = next_cap_pair(observed, cap_pair, cap, p)
+            log.warning("batch overflow (max bucket %d): retrying with "
+                        "cap_pair=%d", observed, cap_pair)
         else:
             raise RuntimeError("sample sort bucket overflow after max retries")
         with timer.phase("assemble"):

@@ -141,3 +141,39 @@ def test_auto_kernel_keeps_floats_on_lax(monkeypatch):
     assert "block" not in called
     assert (out[:100] == np.arange(100, dtype=np.float32)).all()
     assert np.isnan(out[100:]).all()
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint32, np.int64, np.uint64])
+def test_block_sort_pairs_matches_lexsort(dtype):
+    """(key, rank) lexicographic pairs sort: the shuffle-combine building
+    block — rank breaks ties deterministically and returns the permutation."""
+    from dsort_tpu.ops.block_sort import block_sort_pairs
+
+    rng = np.random.default_rng(17)
+    n = 9_000
+    lo, hi = (0, 50) if np.issubdtype(dtype, np.unsignedinteger) else (-25, 25)
+    k = rng.integers(lo, hi, n).astype(dtype)  # heavy duplicates: ranks matter
+    r = rng.permutation(n).astype(np.int32)
+    ok, orr = block_sort_pairs(
+        jnp.asarray(k), jnp.asarray(r), block_rows=64, tile_rows=8,
+        interpret=True,
+    )
+    order = np.lexsort((r, k))
+    np.testing.assert_array_equal(np.asarray(ok), k[order])
+    np.testing.assert_array_equal(np.asarray(orr), r[order])
+
+
+def test_block_sort_pairs_sentinel_keys_with_rank():
+    """Real keys equal to the padding sentinel stay ordered by rank ahead of
+    the int32-max pad ranks."""
+    from dsort_tpu.ops.block_sort import block_sort_pairs
+
+    n = 1500  # non-power-of-two: padding engages
+    k = np.full(n, np.iinfo(np.int32).max, np.int32)
+    r = np.arange(n, dtype=np.int32)[::-1].copy()
+    ok, orr = block_sort_pairs(
+        jnp.asarray(k), jnp.asarray(r), block_rows=64, tile_rows=8,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(ok), k)
+    np.testing.assert_array_equal(np.asarray(orr), np.arange(n, dtype=np.int32))

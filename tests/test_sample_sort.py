@@ -282,3 +282,109 @@ def test_batch_size_bucketing_padded_volume(devices):
         np.testing.assert_array_equal(o, np.sort(j))
     naive = 64 * 4 * 8192  # 64-job batch all padded to the 32K job's layout
     assert m.counters["padded_elems"] <= naive // 8
+
+
+# ---- VERDICT r2 item 1: P=1 short-circuit, measured capacity, kernel merge ----
+
+
+def test_p1_sorts_exactly_once():
+    """On a single-device mesh the SPMD path must invoke exactly ONE local
+    sort — no splitters, no all_to_all, no second (merge) sort."""
+    import jax
+    from jax.sharding import Mesh
+
+    import dsort_tpu.parallel.sample_sort as ssm
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("w",))
+    calls = {"sort_padded": 0}
+    real_sp = ssm.sort_padded
+    real_sk = ssm.sort_keys
+
+    def counting_sp(*a, **kw):
+        calls["sort_padded"] += 1
+        return real_sp(*a, **kw)
+
+    def counting_sk(*a, **kw):
+        raise AssertionError("merge-phase sort ran on a P=1 mesh")
+
+    ssm.sort_padded = counting_sp
+    ssm.sort_keys = counting_sk
+    try:
+        data = gen_uniform(30_000, seed=42)
+        out = SampleSort(mesh1).sort(data)
+    finally:
+        ssm.sort_padded = real_sp
+        ssm.sort_keys = real_sk
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert calls["sort_padded"] == 1  # traced once: one sort in the program
+
+
+def test_capacity_retry_sizes_from_measured_bucket(mesh8):
+    """A skewed overflow converges in ONE measured-size retry, not a
+    doubling ladder."""
+    data = np.concatenate([
+        np.full(30_000, 7, np.int32),        # 3/4 of keys in one bucket
+        gen_uniform(10_000, seed=13),
+    ])
+    m = Metrics()
+    out = SampleSort(mesh8, JobConfig(capacity_factor=1.0)).sort(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters.get("capacity_retries") == 1
+
+
+def test_cap_from_observed_quantizes():
+    from dsort_tpu.parallel.sample_sort import cap_from_observed
+
+    n_local, p = 1 << 16, 8
+    step = n_local // (8 * p)
+    c = cap_from_observed(9_000, n_local, p)
+    assert c >= int(9_000 * 1.05) and c % 8 == 0
+    assert c % step == 0                      # quantized: bounded recompiles
+    assert cap_from_observed(10**9, n_local, p) == n_local  # clamped
+    assert cap_from_observed(0, 64, 2) >= 8
+
+
+def test_merge_kernel_dispatch_is_job_kernel(mesh8, monkeypatch):
+    """The post-shuffle 'sort' merge goes through sort_with_kernel with the
+    JOB's local kernel — not hardcoded lax (VERDICT r2 item 1).  Patching
+    ``ops.local_sort.sort_with_kernel`` observes both call sites: the local
+    sort (via `sort_padded`) and `_merge_received`'s in-function import."""
+    import dsort_tpu.ops.local_sort as lsm
+
+    seen = []
+    real = lsm.sort_with_kernel
+
+    def spy(keys, kernel="auto"):
+        seen.append(kernel)
+        return real(keys, kernel)
+
+    monkeypatch.setattr(lsm, "sort_with_kernel", spy)
+    data = gen_uniform(20_000, seed=14)
+    out = SampleSort(mesh8, JobConfig(local_kernel="bitonic")).sort(data)
+    np.testing.assert_array_equal(out, np.sort(data))
+    # local sorts AND the merge phase both dispatched with the job's kernel
+    assert len(seen) >= 2 and all(k == "bitonic" for k in seen)
+
+
+def test_kv_merge_block_pairs_path(monkeypatch):
+    """Force the kv combine down the block_sort_pairs plane path (interpret
+    mode on CPU) — payloads must follow their keys exactly."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("w",))
+    rng = np.random.default_rng(15)
+    n = 2_000
+    keys = rng.integers(0, 50, n).astype(np.int32)  # duplicates: perm matters
+    payload = rng.integers(0, 256, (n, 4)).astype(np.uint8)
+    out_k, out_v = SampleSort(mesh2, JobConfig(local_kernel="block")).sort_kv(
+        keys, payload
+    )
+    np.testing.assert_array_equal(out_k, np.sort(keys))
+    # every record's payload still sits next to its key (multiset match per key)
+    for v in np.unique(keys):
+        got = out_v[out_k == v]
+        want = payload[keys == v]
+        got_set = {bytes(r) for r in got}
+        want_set = {bytes(r) for r in want}
+        assert got_set == want_set and len(got) == len(want)
