@@ -51,6 +51,7 @@ class SortWorker:
         backend: str = "jax",
         heartbeat_interval_s: float = 1.0,
         connect_timeout_s: float = 30.0,
+        kernel: str = "auto",
     ):
         self.host = host
         self.port = port
@@ -71,9 +72,11 @@ class SortWorker:
                 # entrypoint (never passes through cli.main), so it must
                 # enable x64 itself.
                 jax.config.update("jax_enable_x64", True)
-            from dsort_tpu.ops.local_sort import sort_keys
+            # The worker owns its kernel (client.c:140-173): ``auto`` routes
+            # to the block kernel on a TPU-attached worker, lax elsewhere.
+            from dsort_tpu.ops.local_sort import sort_with_kernel
 
-            self._jit_sort = jax.jit(sort_keys)
+            self._jit_sort = jax.jit(lambda x: sort_with_kernel(x, kernel))
         else:
             self._jit_sort = None
 
@@ -146,6 +149,8 @@ def main(argv=None) -> int:
     ap.add_argument("--conf", help="reference-format client.conf (SERVER_IP/SERVER_PORT)")
     ap.add_argument("--dtype", default="int32")
     ap.add_argument("--backend", choices=["jax", "numpy"], default="jax")
+    ap.add_argument("--kernel", default="auto",
+                    choices=["auto", "lax", "block", "bitonic", "pallas", "radix"])
     args = ap.parse_args(argv)
     host, port = args.host, args.port
     if args.conf:
@@ -154,7 +159,8 @@ def main(argv=None) -> int:
         conf = load_conf_file(args.conf)
         host = conf.get("SERVER_IP", host)
         port = int(conf.get("SERVER_PORT", port))
-    SortWorker(host, port, dtype=args.dtype, backend=args.backend).serve_forever()
+    SortWorker(host, port, dtype=args.dtype, backend=args.backend,
+               kernel=args.kernel).serve_forever()
     return 0
 
 

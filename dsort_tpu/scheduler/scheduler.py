@@ -66,11 +66,23 @@ class DeviceExecutor:
         devices: list[jax.Device] | None = None,
         injector: FaultInjector | None = None,
         table: WorkerTable | None = None,
+        kernel: str = "auto",
     ):
         self.devices = list(devices) if devices is not None else jax.devices()
         self.injector = injector
         self.table = table
-        self._sort = jax.jit(lambda x: jax.numpy.sort(x))
+        self.set_kernel(kernel)
+
+    def set_kernel(self, kernel: str) -> None:
+        """Select the local sort kernel (the worker owns its kernel, like the
+        reference's ``client.c:140-173``).  ``auto`` = block kernel on TPU for
+        large integer keys, lax elsewhere; key-only sorts need no stability
+        (equal keys are indistinguishable), so this replaces the old stable
+        ``jnp.sort`` default — slower even than unstable lax (VERDICT r2)."""
+        from dsort_tpu.ops.local_sort import sort_with_kernel
+
+        self.kernel = kernel
+        self._sort = jax.jit(lambda x: sort_with_kernel(x, kernel))
 
     @property
     def num_workers(self) -> int:
@@ -103,6 +115,12 @@ class Scheduler:
             executor.num_workers, self.job.heartbeat_timeout_s
         )
         executor.table = self.table
+        if executor.kernel != self.job.local_kernel:
+            # JobConfig.local_kernel reaches this mode too (VERDICT r2): the
+            # job's kernel choice wins over the executor's construction-time
+            # default.
+            executor.set_kernel(self.job.local_kernel)
+        self._warm_shapes: set = set()  # (shape, dtype) combos already compiled
 
     def _attempt(self, worker: int, shard: np.ndarray) -> np.ndarray:
         """One exchange attempt on one worker, bounded by the heartbeat timeout.
@@ -128,10 +146,18 @@ class Scheduler:
                 done.set()
 
         threading.Thread(target=run, daemon=True).start()
-        if not done.wait(timeout=self.job.heartbeat_timeout_s):
+        # A cold (shape, dtype) pays XLA/Mosaic compilation inside the
+        # attempt (30-150 s through a remote compiler) — that must not read
+        # as a hung worker, so the first attempt per combo gets extra grace.
+        key = (shard.shape, str(shard.dtype), self.executor.kernel)
+        timeout = self.job.heartbeat_timeout_s + (
+            0.0 if key in self._warm_shapes else self.job.compile_grace_s
+        )
+        if not done.wait(timeout=timeout):
             raise TimeoutError(f"worker {worker} heartbeat timeout")
         if "e" in box:
             raise box["e"]
+        self._warm_shapes.add(key)
         return box["r"]
 
     def _handle_shard(

@@ -89,7 +89,11 @@ def test_hung_worker_detected_by_timeout():
     # §5.3); we must declare it dead and reassign.
     inj = FaultInjector()
     inj.hang_once(0, "sort", seconds=60.0)
-    job = JobConfig(settle_delay_s=0.01, heartbeat_timeout_s=1.0)
+    # compile_grace_s=0: CPU jits are instant, so the hang (on a cold shape,
+    # where real TPU runs get a compile grace window) is detected at the
+    # bare heartbeat timeout.
+    job = JobConfig(settle_delay_s=0.01, heartbeat_timeout_s=1.0,
+                    compile_grace_s=0.0)
     sched = Scheduler(DeviceExecutor(injector=inj), job)
     data = gen_uniform(4_000, seed=6)
     m = Metrics()
