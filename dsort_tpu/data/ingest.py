@@ -65,9 +65,15 @@ def gen_uniform(n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:
 
 
 def gen_zipf(n: int, a: float = 1.3, dtype=np.int64, seed: int = 0) -> np.ndarray:
-    """Zipf-skewed keys (BASELINE config #5) — stresses splitter balance."""
+    """Zipf-skewed keys (BASELINE config #5) — stresses splitter balance.
+
+    Values are clipped (not wrapped) into ``dtype``'s range: the heavy tail
+    of a=1.3 exceeds int32 with probability ~1e-3 per draw, and a silent
+    wraparound would turn skew-stress data into negative noise.
+    """
     rng = np.random.default_rng(seed)
-    return rng.zipf(a, size=n).astype(dtype)
+    vals = rng.zipf(a, size=n)
+    return np.minimum(vals, np.iinfo(dtype).max).astype(dtype)
 
 
 RECORD_BYTES = 100  # TeraSort record: 10-byte key + 90-byte value

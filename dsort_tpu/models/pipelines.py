@@ -39,6 +39,66 @@ def local_pipeline(shards: jax.Array, counts: jax.Array):
 local_pipeline_step = jax.jit(local_pipeline)
 
 
+#: Jobs strictly below this many keys auto-route to `fused_sort_small` in
+#: the CLI's spmd mode: the SPMD driver's ~7 host<->device dispatches
+#: dominate jobs this small (each costs ~70-100 ms through a relay tunnel),
+#: while one fused program pays ~2.  At and above it (2^20 keys) the
+#: collective path wins on compute.
+FUSED_SMALL_JOB_MAX = 1 << 20
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_small_fn(n_pad: int, dtype_str: str, kernel: str):
+    del dtype_str  # part of the cache key; the jit re-specializes by dtype
+
+    @jax.jit
+    def f(x, count):
+        out, _ = sort_padded(x, count, kernel)
+        return out
+
+    return f
+
+
+def fused_sort_small(
+    data: np.ndarray, kernel: str = "auto", metrics: Metrics | None = None
+) -> np.ndarray:
+    """A whole small job as ONE device program: one H2D, one execute, one D2H.
+
+    The reference's complete job (read → scatter → sort → gather → merge,
+    ``server.c:160-268``) collapses to a single padded on-device sort when
+    the data fits one chip — no splitters, no collective, no second sort.
+    Host-side padding to the next power of two bounds recompiles (one
+    compiled program per (pow2 size, dtype, kernel)); the pad region is
+    masked to the dtype sentinel on device by `sort_padded`, so trimming to
+    the input length is exact even for sentinel-valued real keys.
+    """
+    data = np.asarray(data)
+    if is_float_key_dtype(data.dtype):
+        return sort_float_keys_via_uint(
+            lambda d, m: fused_sort_small(d, kernel, m), data, metrics
+        )
+    metrics = metrics if metrics is not None else Metrics()
+    timer = PhaseTimer(metrics)
+    n = len(data)
+    if n == 0:
+        return data.copy()
+    # Pad to 1/8-of-a-power-of-two granularity, not a full power of two:
+    # <= 12.5% padded work at any size (a big job padded to the next pow2
+    # would pay up to 2x) while still bounding distinct compiled programs
+    # to 8 per size decade.
+    step = max(8, 1 << max((n - 1).bit_length() - 3, 0))
+    n_pad = -(-n // step) * step
+    with timer.phase("partition"):
+        buf = np.empty(n_pad, data.dtype)
+        buf[:n] = data  # tail garbage is sentinel-masked on device
+        x = jnp.asarray(buf)
+    with timer.phase("local_sort"):
+        out = _fused_small_fn(n_pad, str(data.dtype), kernel)(x, np.int32(n))
+        out.block_until_ready()
+    with timer.phase("assemble"):
+        return np.asarray(out)[:n]
+
+
 class GatherMergeSort:
     """Per-device local sort + gather + host merge (BASELINE config #2).
 
