@@ -76,18 +76,22 @@ def _tile_sort(x2d: jax.Array, rows: int, interpret: bool) -> jax.Array:
 
     total_rows = x2d.shape[0]
     grid = (total_rows // rows,)
-    return pl.pallas_call(
-        functools.partial(_tile_bitonic_kernel, rows=rows),
-        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
-        ],
-        out_specs=pl.BlockSpec(
-            (rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
-        ),
-        interpret=interpret,
-    )(x2d)
+    # Trace with x64 disabled: under the framework's global x64 (int64 key
+    # dtypes) python-int roll amounts/indices promote to i64, which Mosaic
+    # ops (tpu.dynamic_rotate & co) reject — same guard as ops.block_sort.
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            functools.partial(_tile_bitonic_kernel, rows=rows),
+            out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec(
+                (rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            interpret=interpret,
+        )(x2d)
 
 
 def _on_tpu() -> bool:
@@ -159,17 +163,18 @@ def _tile_sort_kv(k2d: jax.Array, v2d: jax.Array, rows: int, interpret: bool):
     spec = lambda dt: pl.BlockSpec(
         (rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
-    return pl.pallas_call(
-        functools.partial(_tile_bitonic_kv_kernel, rows=rows),
-        out_shape=(
-            jax.ShapeDtypeStruct(k2d.shape, k2d.dtype),
-            jax.ShapeDtypeStruct(v2d.shape, v2d.dtype),
-        ),
-        grid=grid,
-        in_specs=[spec(k2d.dtype), spec(v2d.dtype)],
-        out_specs=(spec(k2d.dtype), spec(v2d.dtype)),
-        interpret=interpret,
-    )(k2d, v2d)
+    with jax.enable_x64(False):  # see _tile_sort
+        return pl.pallas_call(
+            functools.partial(_tile_bitonic_kv_kernel, rows=rows),
+            out_shape=(
+                jax.ShapeDtypeStruct(k2d.shape, k2d.dtype),
+                jax.ShapeDtypeStruct(v2d.shape, v2d.dtype),
+            ),
+            grid=grid,
+            in_specs=[spec(k2d.dtype), spec(v2d.dtype)],
+            out_specs=(spec(k2d.dtype), spec(v2d.dtype)),
+            interpret=interpret,
+        )(k2d, v2d)
 
 
 def pallas_sort_kv(
@@ -253,6 +258,13 @@ def radix_histogram(
     (tile_rows, 128) VMEM tiles over a sequential grid; the input is padded
     with zeros and the pad count is subtracted from bucket 0 of the pad
     digit, so the result is exact for every length.
+
+    Status (measured, r2): built as the counting pass of an MSD radix
+    reorder that was prototyped and REJECTED on numbers (per-fragment DMA
+    count ~ntiles x buckets exceeds the ~20% stage saving vs the block
+    network — ``ops.block_sort`` docstring).  Kept as a tested, on-chip-
+    verified primitive and the recorded evidence behind that design call;
+    nothing in the production sort paths consumes it.
     """
     if interpret is None:
         interpret = not _on_tpu()
@@ -267,20 +279,21 @@ def radix_histogram(
     padded_n = num_tiles * tile
     xp = jnp.concatenate([x, jnp.zeros(padded_n - n, dtype=x.dtype)])
 
-    out = pl.pallas_call(
-        functools.partial(_tile_histogram_kernel, shift=shift, bits=bits),
-        out_shape=jax.ShapeDtypeStruct((out_rows, LANES), jnp.int32),
-        grid=(num_tiles,),
-        in_specs=[
-            pl.BlockSpec(
-                (tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
-            )
-        ],
-        out_specs=pl.BlockSpec(
-            (out_rows, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
-        ),
-        interpret=interpret,
-    )(xp.reshape(-1, LANES))
+    with jax.enable_x64(False):  # see _tile_sort
+        out = pl.pallas_call(
+            functools.partial(_tile_histogram_kernel, shift=shift, bits=bits),
+            out_shape=jax.ShapeDtypeStruct((out_rows, LANES), jnp.int32),
+            grid=(num_tiles,),
+            in_specs=[
+                pl.BlockSpec(
+                    (tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (out_rows, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            interpret=interpret,
+        )(xp.reshape(-1, LANES))
     hist = out.reshape(-1)[:num_buckets]
     return hist.at[0].add(-(padded_n - n))  # zero pads all land in bucket 0
 

@@ -21,12 +21,16 @@ at the input tail, so among equal (sentinel-valued) keys they sort last and
 trimming to the valid count never drops a real record — no key value is
 reserved, unlike the reference's in-band ``-1`` (``server.c:405-406``).
 
-Performance note (honest): on TPU the per-pass scatter is the weak spot —
-XLA lowers large dynamic scatters conservatively — so ``lax`` (XLA's fused
-bitonic-family sort) remains the default local kernel; ``radix`` is the
-algorithmically-linear alternative and the right base for payload-heavy
-records where comparison sorts pay to move payload through every
-compare-exchange stage.
+Performance note (measured truth, r2): on TPU the per-pass scatter is fatal —
+XLA's scatter/gather of a 2^24 permutation runs at 114-148 Mkeys/s, and the
+whole radix path measures ~5.5 Mkeys/s vs the block kernel's ~1.5 Gkeys/s
+(~275x).  An MSD bucket/radix reorder was also prototyped and rejected on
+numbers (per-fragment DMA count ~ntiles x buckets; see ``ops.block_sort``).
+The family stays for its *stability* (the only stable linear-time kernel,
+exercised by tests) and as the recorded evidence for why the comparison
+network won — NOT as a recommended base for payload-heavy records; payloads
+ride the measured-faster ``lax.sort`` multi-operand path instead
+(``ops.local_sort.sort_kv``).
 """
 
 from __future__ import annotations

@@ -12,14 +12,21 @@ pinned in ``JAX_PLATFORMS``; env vars alone are then too late, so we also use
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+if os.environ.get("DSORT_TPU_TESTS") == "1":
+    # Hardware-gate mode: leave the real backend in charge so
+    # tests/test_tpu_smoke.py runs on the chip —
+    #   DSORT_TPU_TESTS=1 python -m pytest tests/test_tpu_smoke.py -q
+    import jax
+else:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
+
 jax.config.update("jax_enable_x64", True)  # 64-bit key dtypes (BASELINE config #3)
 
 import pytest  # noqa: E402
