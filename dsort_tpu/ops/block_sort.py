@@ -32,9 +32,33 @@ a merge-block boundary.  The pass structure:
   applies the distance-one-block stage as a row exchange at ``j = rows``,
   then finishes BOTH halves' intra-block stages in VMEM before writing once.
 
+- **K2a (fused low levels)**: every merge level whose exchanges stay inside
+  an aligned ``2*span_m``-block window (kb = 2..2*span_m — distances flip
+  only low block-index bits) runs in ONE span-resident pass with fully
+  static stage lists, replacing four per-level span-tail passes.
+
 K2/K2b/K3 take the merge level as an SMEM scalar, so one compilation serves
-every level.  Total HBM passes for 2^24 at the defaults: 1 (K1) +
-6 (K2b) + 2 (K2) + 7 (K3) = 16, vs ~250 for ``lax.sort``.
+every level.  Total HBM passes for 2^24 at the defaults: 1 (K1) + 1 (K2a) +
+6 (K2) + 3 (K2b/K3) = 11, vs ~250 for ``lax.sort``.
+
+Measured pass costs at 2^24 int32 (v5e via tunnel, slope method, r3 —
+model sum matches the full-kernel slope within 3%):
+
+  ====================  ========  ======================================
+  pass                  ms/pass   vs its own bound
+  ====================  ========  ======================================
+  K1 tile sort          3.32      ~92% of VPU ops bound (~3.0 ms: 125
+                                  row-stages x ~5 + 28 lane x ~13 ops)
+  K2 cross (any m)      0.19-.21  at DMA bound (2n bytes @ ~725 GB/s)
+  K2b/K3 span-tail      0.43-.90  kb=2 at its ~0.5 ms ops bound; high kb
+                                  runs above it (direction-mask overhead)
+  full kernel           9.14      sum-of-passes 8.91; ~85% VPU-bound
+  ====================  ========  ======================================
+
+The kernel is compute-bound on the VPU, not HBM-bound: total DMA is only
+~11 x 0.17 ms.  Further gains must cut *stages* (hence K2a's fusion) or
+per-stage ops; the stage formulations below are already the cheapest of
+the measured alternatives.
 
 Exchange formulations are chosen per distance from on-chip microbenchmarks:
 vreg-aligned row distances (j >= 8) use a pair view ``(pairs, 2, j, 128)``
@@ -368,18 +392,82 @@ def _span_tail_kernel(k_ref, *refs, rows: int, m_hi: int, np_: int):
     rowi_span = jax.lax.broadcasted_iota(jnp.int32, (span * rows, 1), 0)
     blk = pl.program_id(0) * span + rowi_span // rows
     asc_rows = (blk & kb) == 0  # (span*rows, 1), constant per block
-    m = m_hi
-    while m >= 2:
-        xs = _exchange_rows(xs, m * rows, asc_rows, active=kb >= 2 * m)
-        m //= 2
-    xs = _exchange_rows(xs, rows, asc_rows)  # distance-one-block stage
     lane = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 1)
     rowi = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 0)
-    # Intra-block distances rows*LANES/2 .. 1 for all blocks of the span.
-    xs = _level_stages(xs, rows * LANES, span * rows, lane, rowi,
-                       asc_top=asc_rows)
+    xs = _level_pass(xs, asc_rows, m_hi, rows, span * rows, lane, rowi,
+                     active_for=lambda m: kb >= 2 * m)
     for o_ref, x in zip(refs[np_:], xs):
         o_ref[:] = x
+
+
+def _level_pass(xs, asc_rows, m_top: int, rows: int, span_rows: int,
+                lane, rowi, active_for=None):
+    """One merge level's in-span stage sequence, shared by K2a and K2b/K3:
+    cross stages at block distances ``m_top..2`` (optionally predicated via
+    ``active_for(m)`` when the level arrives at runtime), the distance-one-
+    block stage, then every block's intra-block merge tail."""
+    m = m_top
+    while m >= 2:
+        act = None if active_for is None else active_for(m)
+        xs = _exchange_rows(xs, m * rows, asc_rows, active=act)
+        m //= 2
+    xs = _exchange_rows(xs, rows, asc_rows)
+    return _level_stages(xs, rows * LANES, span_rows, lane, rowi,
+                         asc_top=asc_rows)
+
+
+def _span_low_kernel(*refs, rows: int, m_hi: int, np_: int):
+    """Fused LOW merge levels: kb = 2 .. 2*m_hi complete in ONE pass.
+
+    Every exchange of a level ``kb <= 2*m_hi`` pairs blocks at distances
+    ``<= m_hi``, i.e. strictly inside an aligned ``2*m_hi``-block window
+    (``i ^ m`` flips only bits below log2(2*m_hi)), so one VMEM residency
+    of the window runs all of those levels' cross stages AND merge tails
+    back-to-back.  At the defaults this replaces FOUR per-level span-tail
+    passes (kb=2,4,8,16) with one — 3 fewer HBM round trips — and, because
+    every ``kb`` here is static, the predicated no-op stages the runtime-
+    parametrized span-tail pays at low levels vanish.
+    """
+    import jax.experimental.pallas as pl
+
+    xs = tuple(r[:] for r in refs[:np_])
+    span = 2 * m_hi
+    rowi_span = jax.lax.broadcasted_iota(jnp.int32, (span * rows, 1), 0)
+    blk = pl.program_id(0) * span + rowi_span // rows
+    lane = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 1)
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 0)
+    kb = 2
+    while kb <= span:
+        asc_rows = (blk & kb) == 0  # per-block direction, constant per pair
+        xs = _level_pass(xs, asc_rows, kb // 2, rows, span * rows, lane, rowi)
+        kb *= 2
+    for o_ref, x in zip(refs[np_:], xs):
+        o_ref[:] = x
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "m_hi", "interpret"))
+def _span_low(xs, rows: int, m_hi: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    span_rows = 2 * m_hi * rows
+    t = xs[0].shape[0] // span_rows
+    spec = pl.BlockSpec(
+        (span_rows, LANES), lambda g: (g, 0), memory_space=pltpu.VMEM
+    )
+    with jax.enable_x64(False):  # see _tile_sort_cm
+        out = pl.pallas_call(
+            functools.partial(
+                _span_low_kernel, rows=rows, m_hi=m_hi, np_=len(xs)
+            ),
+            out_shape=_shapes(xs),
+            grid=(t,),
+            in_specs=[spec] * len(xs),
+            out_specs=tuple([spec] * len(xs)),
+            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 << 20),
+            interpret=interpret,
+        )(*xs)
+    return out
 
 
 def _vmem(rows):
@@ -534,7 +622,13 @@ def _sort_planes(
     span_m_hi = max(SPAN_M_HI // nplanes, 1)
     t_blocks = total_rows // blk
     span_m = max(min(span_m_hi, t_blocks // 2), 1)
-    k = 2 * b
+    if t_blocks <= 1:
+        return xs
+    # K2a (fused low levels): every level kb <= 2*span_m completes in ONE
+    # span-resident pass (measured r3: replaces 4 span-tail passes with 1,
+    # -14% kernel wall time at 2^24).
+    xs = _as_tuple(_span_low(xs, blk, span_m, interpret), nplanes)
+    k = 4 * span_m * b
     while k <= p:
         kb = jnp.full((1, 1), k // b, jnp.int32)
         m = k // (2 * b)
