@@ -294,13 +294,32 @@ def cmd_gen(args) -> int:
     from dsort_tpu.data.ingest import (
         gen_terasort_file,
         gen_uniform,
+        gen_uniform_bin_file,
         gen_zipf,
         write_ints_file,
     )
 
     if args.dist == "terasort":
+        if args.format == "bin":
+            # TeraSort output is ALWAYS binary 100-byte records; a --format
+            # bin here would silently be ignored while the user expects raw
+            # keys — refuse loudly instead (code-review r3).
+            raise SystemExit(
+                "--format bin is for raw key files; --dist terasort always "
+                "writes binary 100-byte records (drop --format)"
+            )
         gen_terasort_file(args.output, args.n, seed=args.seed)
         log.info("wrote %d terasort records to %s", args.n, args.output)
+        return 0
+    if args.format == "bin":
+        # Raw binary keys (ExternalSort's input format), streamed in bounded
+        # memory — the only practical format at 10^9-key scale.
+        if args.dist != "uniform":
+            raise SystemExit("--format bin supports --dist uniform only")
+        gen_uniform_bin_file(
+            args.output, args.n, dtype=np.dtype(args.dtype), seed=args.seed
+        )
+        log.info("wrote %d %s binary keys to %s", args.n, args.dtype, args.output)
         return 0
     if args.dist == "uniform":
         data = gen_uniform(args.n, dtype=np.dtype(args.dtype), seed=args.seed)
@@ -397,14 +416,18 @@ def cmd_external(args) -> int:
 def cmd_validate(args) -> int:
     """Validate a sort output (valsort role): order + permutation-of-input."""
     from dsort_tpu.models.validate import (
+        checksum_bin_file,
         checksum_ints_file,
         checksum_terasort_file,
+        validate_bin_file,
         validate_ints_file,
         validate_terasort_file,
     )
 
     if args.terasort:
         rep = validate_terasort_file(args.input)
+    elif args.binary:
+        rep = validate_bin_file(args.input, dtype=np.dtype(args.dtype))
     else:
         rep = validate_ints_file(args.input, dtype=np.dtype(args.dtype))
     result = {
@@ -418,6 +441,8 @@ def cmd_validate(args) -> int:
     if args.against:
         if args.terasort:
             n_in, sum_in = checksum_terasort_file(args.against)
+        elif args.binary:
+            n_in, sum_in = checksum_bin_file(args.against, dtype=np.dtype(args.dtype))
         else:
             n_in, sum_in = checksum_ints_file(args.against, dtype=np.dtype(args.dtype))
         result["permutation_of_input"] = (
@@ -518,6 +543,8 @@ def main(argv=None) -> int:
     p.add_argument("--dtype", default="int32")
     p.add_argument("--zipf-a", type=float, default=1.3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--format", default="text", choices=["text", "bin"],
+                   help="'bin' streams raw binary keys (external-sort input)")
     p.set_defaults(fn=cmd_gen)
 
     p = sub.add_parser("terasort", help="sort a binary 100-byte-record file")
@@ -553,6 +580,8 @@ def main(argv=None) -> int:
     p.add_argument("--against", help="original input file to prove permutation")
     p.add_argument("--terasort", action="store_true",
                    help="treat files as binary 100-byte-record TeraSort data")
+    p.add_argument("--binary", action="store_true",
+                   help="treat files as raw binary key arrays (streamed)")
     p.add_argument("--dtype", default="int32")
     p.set_defaults(fn=cmd_validate)
 

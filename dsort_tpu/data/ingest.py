@@ -64,6 +64,28 @@ def gen_uniform(n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:
     return rng.integers(info.min, info.max, size=n, dtype=dtype, endpoint=False)
 
 
+def gen_uniform_bin_file(
+    path: str | os.PathLike, n: int, dtype=np.int32, seed: int = 0,
+    chunk: int = 1 << 24,
+) -> None:
+    """Stream ``n`` uniform keys to a raw binary file in bounded memory.
+
+    The binary twin of `gen_uniform` for jobs too big to hold as text
+    (10^9 int32 = 4 GB binary vs ~10.5 GB ASCII): `ExternalSort`'s input
+    format, one little-endian key after another.
+    """
+    rng = np.random.default_rng(seed)
+    dtype = np.dtype(dtype)
+    info = np.iinfo(dtype)
+    with open(path, "wb") as f:
+        for lo in range(0, n, chunk):
+            m = min(chunk, n - lo)
+            f.write(
+                rng.integers(info.min, info.max, size=m, dtype=dtype,
+                             endpoint=False).tobytes()
+            )
+
+
 def gen_zipf(n: int, a: float = 1.3, dtype=np.int64, seed: int = 0) -> np.ndarray:
     """Zipf-skewed keys (BASELINE config #5) — stresses splitter balance.
 

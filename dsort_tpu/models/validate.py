@@ -133,6 +133,67 @@ def checksum_terasort_file(path: str | os.PathLike) -> tuple[int, int]:
     return nrec, checksum
 
 
+# ---- raw binary key files (ExternalSort's format), streamed ----
+
+_CHUNK_ELEMS = 1 << 24  # 64-128 MB of keys per streamed chunk
+
+
+def _iter_key_chunks(path: str | os.PathLike, dtype) -> Iterator[tuple[int, np.ndarray]]:
+    dtype = np.dtype(dtype)
+    size = os.path.getsize(path)
+    if size % dtype.itemsize:
+        raise ValueError(
+            f"{path}: size {size} not a multiple of itemsize {dtype.itemsize}"
+        )
+    n = size // dtype.itemsize
+    if n == 0:
+        return
+    mm = np.memmap(path, dtype=dtype, mode="r")
+    for lo in range(0, n, _CHUNK_ELEMS):
+        yield lo, np.array(mm[lo : min(lo + _CHUNK_ELEMS, n)])
+
+
+def validate_bin_file(path: str | os.PathLike, dtype=np.int32) -> ValidationReport:
+    """Validate a raw binary key file out-of-core: order + multiset checksum.
+
+    The 10^9-key twin of `validate_ints_file`: chunks stream through a
+    memmap (order checks compare each chunk's first key against the
+    previous chunk's last), so a 4 GB artifact validates in bounded memory.
+    """
+    n_total = 0
+    checksum = 0
+    sorted_ok = True
+    first_violation: int | None = None
+    prev_last = None
+    for lo, chunk in _iter_key_chunks(path, dtype):
+        n_total = lo + len(chunk)
+        if sorted_ok:
+            if prev_last is not None and chunk[0] < prev_last:
+                sorted_ok, first_violation = False, lo
+            elif len(chunk) > 1:
+                diffs_ok = chunk[1:] >= chunk[:-1]
+                if not diffs_ok.all():
+                    sorted_ok = False
+                    first_violation = lo + int(np.argmin(diffs_ok)) + 1
+        checksum = (
+            checksum + _multiset(chunk, len(chunk), chunk.dtype.itemsize)
+        ) & _MASK64
+        prev_last = chunk[-1]
+    return ValidationReport(n_total, sorted_ok, first_violation, checksum)
+
+
+def checksum_bin_file(path: str | os.PathLike, dtype=np.int32) -> tuple[int, int]:
+    """(key count, multiset checksum) of a raw binary key file, streamed."""
+    n_total = 0
+    checksum = 0
+    for lo, chunk in _iter_key_chunks(path, dtype):
+        n_total = lo + len(chunk)
+        checksum = (
+            checksum + _multiset(chunk, len(chunk), chunk.dtype.itemsize)
+        ) & _MASK64
+    return n_total, checksum
+
+
 def validate_ints_file(
     path: str | os.PathLike, dtype=np.int32
 ) -> ValidationReport:

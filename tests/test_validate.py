@@ -110,3 +110,52 @@ def test_python_fnv_fallback_matches_native():
     assert _fnv_multiset_py(buf, 500, 100) == native.fnv_multiset(buf, 500, 100)
     ints = rng.integers(-(2**31), 2**31 - 1, 777).astype(np.int32)
     assert _fnv_multiset_py(ints, 777, 4) == native.fnv_multiset(ints, 777, 4)
+
+
+def test_binary_key_file_validate_roundtrip(tmp_path):
+    """gen --format bin -> sort -> validate --binary: the 1B-key artifact
+    flow at test scale, incl. the chunk-boundary order check."""
+    import dsort_tpu.models.validate as V
+    from dsort_tpu.data.ingest import gen_uniform_bin_file
+
+    src = tmp_path / "in.bin"
+    out = tmp_path / "out.bin"
+    gen_uniform_bin_file(src, 100_000, dtype=np.int32, seed=5, chunk=8192)
+    data = np.fromfile(src, dtype=np.int32)
+    assert len(data) == 100_000
+    np.sort(data).tofile(out)
+    # stream in small chunks so boundary comparisons actually engage
+    old = V._CHUNK_ELEMS
+    V._CHUNK_ELEMS = 4096
+    try:
+        rep = V.validate_bin_file(out, dtype=np.int32)
+        assert rep.ok and rep.records == 100_000
+        n_in, sum_in = V.checksum_bin_file(src, dtype=np.int32)
+        assert (n_in, sum_in) == (rep.records, rep.checksum)
+        # an out-of-order boundary is caught
+        bad = np.sort(data)
+        bad[4096], bad[4095] = bad[4095], bad[4096]
+        if bad[4096] == bad[4095]:
+            bad[4096] = bad[4095] - 1
+        bad.tofile(out)
+        rep2 = V.validate_bin_file(out, dtype=np.int32)
+        assert not rep2.ok and rep2.first_violation == 4096
+        # a dropped key fails the permutation proof
+        np.sort(data)[:-1].tofile(out)
+        rep3 = V.validate_bin_file(out, dtype=np.int32)
+        assert rep3.ok and rep3.checksum != sum_in
+    finally:
+        V._CHUNK_ELEMS = old
+
+
+def test_cli_gen_bin_external_validate(tmp_path):
+    """CLI surface: dsort gen --format bin -> external -> validate --binary."""
+    from dsort_tpu import cli
+
+    src, out = str(tmp_path / "a.bin"), str(tmp_path / "b.bin")
+    assert cli.main(["gen", "50000", "-o", src, "--format", "bin"]) == 0
+    assert cli.main([
+        "external", src, "-o", out, "--run-elems", "8192",
+        "--spill-dir", str(tmp_path / "spill"), "--job-id", "binjob",
+    ]) == 0
+    assert cli.main(["validate", out, "--binary", "--against", src]) == 0
