@@ -25,7 +25,14 @@ class ShardCheckpoint:
     """Per-job shard result store keyed by (checkpoint_dir, job_id)."""
 
     def __init__(self, root: str, job_id: str):
-        if not job_id or "/" in job_id:
+        # Defense in depth against path escape: a job_id like '..' would
+        # resolve outside `root`, and clear() rmtrees self.dir — refuse
+        # anything that is not a plain directory-name-safe token.
+        if (
+            not job_id
+            or not job_id.strip(".")
+            or any(s in job_id for s in ("/", "\\", os.sep))
+        ):
             raise ValueError(f"invalid job_id {job_id!r}")
         self.dir = os.path.join(root, job_id)
         os.makedirs(self.dir, exist_ok=True)

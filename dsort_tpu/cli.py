@@ -33,27 +33,56 @@ log = get_logger("cli")
 
 
 def _load_config(args) -> SortConfig:
+    """Conf file + CLI overrides, applied field-wise.
+
+    Overrides use ``dataclasses.replace`` on the loaded config — NOT a
+    rebuild through a key mapping — so a single CLI flag can never silently
+    drop conf-file settings it doesn't know about (code-review r3).
+    """
+    import dataclasses
+
     cfg = SortConfig.from_conf_file(args.conf) if args.conf else SortConfig()
-    overrides = {}
+    job_over: dict = {}
+    mesh_over: dict = {}
     if getattr(args, "workers", None):
-        overrides["NUM_WORKERS"] = str(args.workers)
+        mesh_over["num_workers"] = args.workers
     if getattr(args, "dtype", None):
-        overrides["KEY_DTYPE"] = args.dtype
+        job_over["key_dtype"] = np.dtype(args.dtype)
     if getattr(args, "kernel", None):
-        overrides["LOCAL_KERNEL"] = args.kernel
-    if overrides:
-        base = {
-            "SERVER_IP": cfg.server_ip,
-            "SERVER_PORT": str(cfg.server_port),
-            "KEY_DTYPE": str(np.dtype(cfg.job.key_dtype)),
-            "LOCAL_KERNEL": cfg.job.local_kernel,
-            "MERGE_KERNEL": cfg.job.merge_kernel,
-        }
-        if cfg.mesh.num_workers is not None:
-            base["NUM_WORKERS"] = str(cfg.mesh.num_workers)
-        base.update(overrides)
-        cfg = SortConfig.from_mapping(base)
+        job_over["local_kernel"] = args.kernel
+    if getattr(args, "checkpoint_dir", None):
+        job_over["checkpoint_dir"] = args.checkpoint_dir
+    if job_over:
+        cfg = dataclasses.replace(cfg, job=dataclasses.replace(cfg.job, **job_over))
+    if mesh_over:
+        cfg = dataclasses.replace(
+            cfg, mesh=dataclasses.replace(cfg.mesh, **mesh_over)
+        )
     return cfg
+
+
+def _job_id_for(path: str, explicit: str | None) -> str:
+    """Stable checkpoint job id for a CLI input file.
+
+    Defaults to the sanitized basename, so re-running ``dsort run FILE``
+    after a failure resumes FILE's own checkpoints; the fingerprint guard in
+    the schedulers clears stale state if FILE's contents changed.  An
+    explicit id is validated, not silently rewritten: ids like ``..`` would
+    escape the checkpoint root (and its stale-state clear() would rmtree
+    the parent), so they are refused loudly.
+    """
+    import re
+
+    if explicit:
+        if re.fullmatch(r"[A-Za-z0-9._-]+", explicit) and explicit.strip("."):
+            return explicit
+        raise SystemExit(
+            f"invalid --job-id {explicit!r}: use letters, digits, '.', '_', "
+            "'-' (and not only dots)"
+        )
+    name = os.path.basename(str(path))
+    jid = re.sub(r"[^A-Za-z0-9._-]", "_", name)
+    return jid if jid.strip(".") else "job"
 
 
 def _make_sorter(cfg: SortConfig, mode: str):
@@ -68,13 +97,17 @@ def _make_sorter(cfg: SortConfig, mode: str):
         n = cfg.mesh.num_workers or len(devs)
         sched = SpmdScheduler(devices=devs[:n], job=cfg.job)
 
-        def sorter(data, metrics):
+        def sorter(data, metrics, job_id=None):
             # Small jobs skip the SPMD driver: one fused device program is
             # ~2 dispatches instead of ~7, which dominates at this size
             # (VERDICT r2 item 3).  Fault tolerance is preserved: a device/
             # runtime failure on the fused path falls back to the SPMD
-            # scheduler, which probes, re-forms and retries.
-            if len(data) < FUSED_SMALL_JOB_MAX:
+            # scheduler, which probes, re-forms and retries.  When the user
+            # asked for checkpointing (checkpoint_dir + job_id), the
+            # scheduler path runs even for small jobs — resumability wins
+            # over dispatch count there.
+            checkpointing = cfg.job.checkpoint_dir and job_id
+            if len(data) < FUSED_SMALL_JOB_MAX and not checkpointing:
                 try:
                     out = fused_sort_small(data, cfg.job.local_kernel, metrics)
                     metrics.bump("fused_small_jobs")
@@ -89,7 +122,7 @@ def _make_sorter(cfg: SortConfig, mode: str):
                         "fused small-job path failed (%s); retrying on the "
                         "SPMD scheduler", str(e).splitlines()[0][:120],
                     )
-            return sched.sort(data, metrics=metrics)
+            return sched.sort(data, metrics=metrics, job_id=job_id)
 
         return sorter
     if mode == "taskpool":
@@ -100,28 +133,37 @@ def _make_sorter(cfg: SortConfig, mode: str):
         devs = jax.devices()
         n = cfg.mesh.num_workers or len(devs)
         sched = Scheduler(DeviceExecutor(devices=devs[:n]), cfg.job)
-        return lambda data, metrics: sched.run_job(data, metrics=metrics)
+        return lambda data, metrics, job_id=None: sched.run_job(
+            data, metrics=metrics, job_id=job_id
+        )
     if mode == "local":
         from dsort_tpu.models.pipelines import fused_sort_small
 
-        return lambda data, metrics: fused_sort_small(
+        if cfg.job.checkpoint_dir:
+            log.warning(
+                "--mode local runs one fused device program and does not "
+                "checkpoint; --checkpoint-dir/--job-id are ignored (use "
+                "spmd or taskpool mode for resumable jobs)"
+            )
+        return lambda data, metrics, job_id=None: fused_sort_small(
             data, cfg.job.local_kernel, metrics
         )
     raise SystemExit(f"unknown mode {mode!r}")
 
 
-def _run_one(sorter, in_path: str, out_path: str, dtype) -> None:
+def _run_one(sorter, in_path: str, out_path: str, dtype, job_id=None) -> None:
     from dsort_tpu.data.ingest import read_ints_file, write_ints_file
 
     t0 = time.perf_counter()
     data = read_ints_file(in_path, dtype=dtype)
     metrics = Metrics()
-    out = sorter(data, metrics)
+    out = sorter(data, metrics, job_id=job_id)
     write_ints_file(out_path, out)
     dt = time.perf_counter() - t0
     log.info(
-        "sorted %d keys in %.1f ms (%s) -> %s | phases: %s",
+        "sorted %d keys in %.1f ms (%s) -> %s | phases: %s | %s",
         len(data), dt * 1e3, in_path, out_path, metrics.summary()["phases_ms"],
+        dict(metrics.counters),
     )
 
 
@@ -130,10 +172,13 @@ def cmd_run(args) -> int:
 
     cfg = _load_config(args)
     sorter = _make_sorter(cfg, args.mode)
+    job_id = (
+        _job_id_for(args.input, args.job_id) if cfg.job.checkpoint_dir else None
+    )
     with profile_trace(getattr(args, "profile_dir", None)):
         _run_one(
             sorter, args.input, args.output or cfg.output_path,
-            np.dtype(cfg.job.key_dtype),
+            np.dtype(cfg.job.key_dtype), job_id=job_id,
         )
     if getattr(args, "profile_dir", None):
         log.info("profiler trace written to %s", args.profile_dir)
@@ -145,6 +190,14 @@ def cmd_serve(args) -> int:
     cfg = _load_config(args)
     sorter = _make_sorter(cfg, args.mode)
     dtype = np.dtype(cfg.job.key_dtype)
+    if args.job_id and cfg.job.checkpoint_dir:
+        # One explicit id across many REPL inputs would make every new file
+        # clear the previous file's checkpoints (fingerprint mismatch) —
+        # the per-file derived id is the only sane namespace here.
+        log.warning(
+            "serve mode ignores --job-id: each input file checkpoints under "
+            "its own name"
+        )
     while True:
         try:
             line = input("Enter the filename to sort (or 'exit' to quit): ")
@@ -161,7 +214,11 @@ def cmd_serve(args) -> int:
         if name == "exit":
             return 0
         try:
-            _run_one(sorter, name, args.output or cfg.output_path, dtype)
+            jid = (
+                _job_id_for(name, None) if cfg.job.checkpoint_dir else None
+            )
+            _run_one(sorter, name, args.output or cfg.output_path, dtype,
+                     job_id=jid)
         except Exception as e:  # a bad job must not kill the server
             log.error("job failed: %s", e)
 
@@ -515,6 +572,11 @@ def main(argv=None) -> int:
         p.add_argument("--workers", type=int)
         p.add_argument("--dtype")
         p.add_argument("--kernel", choices=["auto", "lax", "block", "bitonic", "pallas", "radix"])
+        p.add_argument("--checkpoint-dir",
+                       help="persist per-shard/range progress here; a re-run "
+                            "of the same input resumes instead of re-sorting")
+        p.add_argument("--job-id",
+                       help="checkpoint namespace (default: input basename)")
         p.add_argument("-o", "--output")
 
     p = sub.add_parser("run", help="sort one file")

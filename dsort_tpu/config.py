@@ -163,7 +163,7 @@ class SortConfig:
         Accepts the reference's exact keys (``SERVER_IP``, ``SERVER_PORT``)
         plus framework keys (``NUM_WORKERS``, ``KEY_DTYPE``, ``OVERSAMPLE``,
         ``CAPACITY_FACTOR``, ``PAYLOAD_BYTES``, ``HEARTBEAT_TIMEOUT_S``,
-        ``OUTPUT_PATH``, ``DP``).
+        ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``).
         """
         def geti(key: str, default: int | None) -> int | None:
             return int(m[key]) if key in m else default
@@ -186,6 +186,7 @@ class SortConfig:
             heartbeat_timeout_s=float(
                 m.get("HEARTBEAT_TIMEOUT_S", JobConfig.heartbeat_timeout_s)
             ),
+            checkpoint_dir=m.get("CHECKPOINT_DIR") or None,
         )
         return cls(
             mesh=mesh,

@@ -440,13 +440,18 @@ class SampleSort:
             fn = self._build(n_local, cap_pair, None)
             with timer.phase("spmd_sort"):
                 merged, out_counts, overflow, max_len = fn(xs, cj)
-                merged.block_until_ready()
-            if not bool(np.asarray(overflow).any()):
+                # ONE small device->host fetch both forces completion (it
+                # waits on the whole executable) and carries every scalar the
+                # retry loop needs — through a ~70-100 ms/round-trip relay
+                # link, separate block_until_ready + per-array np.asarray
+                # calls were costing 2 extra trips per sort.
+                c, ov, ml = jax.device_get((out_counts, overflow, max_len))
+            if not bool(ov.any()):
                 break
             metrics.bump("capacity_retries")
             # Size the retry from the measured max bucket (one retry
             # converges: splitters are deterministic for the same data).
-            observed = int(np.asarray(max_len).max())
+            observed = int(ml.max())
             cap_pair = next_cap_pair(observed, cap_pair, n_local, p)
             log.warning(
                 "bucket overflow (attempt %d, max bucket %d): retrying with "
@@ -456,7 +461,6 @@ class SampleSort:
             raise RuntimeError("sample sort bucket overflow after max retries")
         with timer.phase("assemble"):
             m = np.asarray(merged).reshape(p, -1)
-            c = np.asarray(out_counts)
             return [m[i, : c[i]] for i in range(p)]
 
     def sort_kv(
@@ -518,18 +522,19 @@ class SampleSort:
                     out_k, _, out_v, out_counts, overflow, max_len = fn(xs, sj, vs, cj)
                 else:
                     out_k, out_v, out_counts, overflow, max_len = fn(xs, vs, cj)
-                out_k.block_until_ready()
-            if not bool(np.asarray(overflow).any()):
+                # One fetch = completion barrier + every retry scalar (see
+                # sort_ranges).
+                c, ov, ml = jax.device_get((out_counts, overflow, max_len))
+            if not bool(ov.any()):
                 break
             metrics.bump("capacity_retries")
-            observed = int(np.asarray(max_len).max())
+            observed = int(ml.max())
             cap_pair = next_cap_pair(observed, cap_pair, n_local, p)
         else:
             raise RuntimeError("sample sort bucket overflow after max retries")
         with timer.phase("assemble"):
             mk = np.asarray(out_k).reshape(p, -1)
             mv = np.asarray(out_v).reshape((p, mk.shape[1]) + sv.shape[2:])
-            c = np.asarray(out_counts)
             keys_out = np.concatenate([mk[i, : c[i]] for i in range(p)])
             vals_out = np.concatenate([mv[i, : c[i]] for i in range(p)])
         return keys_out, vals_out
@@ -656,11 +661,13 @@ class BatchSampleSort:
             fn = self._build(cap, cap_pair)
             with timer.phase("spmd_sort"):
                 merged, out_counts, overflow, max_len = fn(xs, cj)
-                merged.block_until_ready()
-            if not bool(np.asarray(overflow).any()):
+                # One fetch = completion barrier + every retry scalar (see
+                # sort_ranges).
+                c, ov, ml = jax.device_get((out_counts, overflow, max_len))
+            if not bool(ov.any()):
                 break
             metrics.bump("capacity_retries")
-            observed = int(np.asarray(max_len).max())
+            observed = int(ml.max())
             cap_pair = next_cap_pair(observed, cap_pair, cap, p)
             log.warning("batch overflow (max bucket %d): retrying with "
                         "cap_pair=%d", observed, cap_pair)
@@ -668,7 +675,7 @@ class BatchSampleSort:
             raise RuntimeError("sample sort bucket overflow after max retries")
         with timer.phase("assemble"):
             m = np.asarray(merged).reshape(batch, p, -1)
-            c = np.asarray(out_counts).reshape(batch, p)
+            c = c.reshape(batch, p)
             outs = [
                 np.concatenate([m[b, i, : c[b, i]] for i in range(p)])
                 for b in range(n_jobs)

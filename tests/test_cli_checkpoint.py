@@ -194,3 +194,97 @@ def test_cli_bench_suite_runs_all_configs():
     # belong in the benchmark artifact, not a correctness test (ADVICE r1).
     assert all(m["value"] > 0 for m in metrics)
     assert all(("vs_baseline" in m) == (m["unit"] == "keys/sec") for m in metrics)
+
+
+def test_cli_run_with_checkpoint_resume(tmp_path):
+    """dsort run --checkpoint-dir: first run persists ranges under the
+    input-derived job id; a re-run takes the full-restore path."""
+    from dsort_tpu import cli
+    from dsort_tpu.data.ingest import write_ints_file
+
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 10**6, 50_000).astype(np.int32)
+    src = tmp_path / "ck_input.txt"
+    out = tmp_path / "out.txt"
+    write_ints_file(src, data)
+    ck = tmp_path / "ck"
+    argv = ["run", str(src), "-o", str(out), "--mode", "spmd",
+            "--checkpoint-dir", str(ck)]
+    assert cli.main(argv) == 0
+    job_dir = ck / "ck_input.txt"
+    assert job_dir.is_dir() and any(
+        n.startswith("range_") for n in os.listdir(job_dir)
+    )
+    got1 = np.loadtxt(out, dtype=np.int64).astype(np.int32)
+    np.testing.assert_array_equal(got1, np.sort(data))
+    # wipe the output; the re-run restores from the checkpoint and rewrites
+    out.unlink()
+    assert cli.main(argv) == 0
+    got2 = np.loadtxt(out, dtype=np.int64).astype(np.int32)
+    np.testing.assert_array_equal(got2, np.sort(data))
+    # changed data under the same filename: stale state cleared, still exact
+    data2 = rng.integers(0, 10**6, 50_000).astype(np.int32)
+    write_ints_file(src, data2)
+    assert cli.main(argv) == 0
+    got3 = np.loadtxt(out, dtype=np.int64).astype(np.int32)
+    np.testing.assert_array_equal(got3, np.sort(data2))
+
+
+def test_cli_taskpool_checkpoint_flag(tmp_path):
+    from dsort_tpu import cli
+    from dsort_tpu.data.ingest import write_ints_file
+
+    rng = np.random.default_rng(33)
+    data = rng.integers(0, 1000, 9_000).astype(np.int32)
+    src = tmp_path / "tp_in.txt"
+    out = tmp_path / "tp_out.txt"
+    write_ints_file(src, data)
+    argv = ["run", str(src), "-o", str(out), "--mode", "taskpool",
+            "--checkpoint-dir", str(tmp_path / "ck2"), "--job-id", "tpjob"]
+    assert cli.main(argv) == 0
+    assert any(
+        n.startswith("shard_")
+        for n in os.listdir(tmp_path / "ck2" / "tpjob")
+    )
+    got = np.loadtxt(out, dtype=np.int64).astype(np.int32)
+    np.testing.assert_array_equal(got, np.sort(data))
+
+
+def test_cli_job_id_path_escape_rejected(tmp_path):
+    """'..' or separator job ids must be refused, not resolved (a '..' id
+    plus the stale-state clear() would rmtree the checkpoint PARENT)."""
+    from dsort_tpu import cli
+
+    src = tmp_path / "x.txt"
+    write_ints_file(src, np.arange(10, dtype=np.int32))
+    for bad in ("..", ".", "a/b", "a\\b", "..."):
+        with pytest.raises(SystemExit):
+            cli.main([
+                "run", str(src), "-o", str(tmp_path / "o.txt"),
+                "--checkpoint-dir", str(tmp_path / "ck"), "--job-id", bad,
+            ])
+    with pytest.raises(ValueError):
+        ShardCheckpoint(str(tmp_path / "ck"), "..")
+
+
+def test_cli_conf_plus_flag_keeps_conf_settings(tmp_path):
+    """A CLI override must not silently drop unrelated conf-file settings."""
+    from dsort_tpu import cli
+
+    conf = tmp_path / "c.conf"
+    conf.write_text("OVERSAMPLE=64\nCAPACITY_FACTOR=3.0\nOUTPUT_PATH=zz.txt\n")
+
+    class A:
+        pass
+
+    a = A()
+    a.conf = str(conf)
+    a.workers = None
+    a.dtype = None
+    a.kernel = None
+    a.checkpoint_dir = str(tmp_path / "ck")
+    cfg = cli._load_config(a)
+    assert cfg.job.oversample == 64
+    assert cfg.job.capacity_factor == 3.0
+    assert cfg.output_path == "zz.txt"
+    assert cfg.job.checkpoint_dir == str(tmp_path / "ck")
