@@ -288,3 +288,51 @@ def test_cli_conf_plus_flag_keeps_conf_settings(tmp_path):
     assert cfg.job.capacity_factor == 3.0
     assert cfg.output_path == "zz.txt"
     assert cfg.job.checkpoint_dir == str(tmp_path / "ck")
+
+
+def test_cli_batch_sorts_many_files(tmp_path):
+    """dsort batch: many files through ONE (dp, w) batched SPMD program."""
+    from dsort_tpu import cli
+
+    rng = np.random.default_rng(37)
+    paths = []
+    datas = []
+    for i, n in enumerate((5_000, 12_345, 17)):
+        d = rng.integers(-1000, 1000, n).astype(np.int32)
+        p = tmp_path / f"job{i}.txt"
+        write_ints_file(p, d)
+        paths.append(str(p))
+        datas.append(d)
+    outdir = tmp_path / "sorted"
+    assert cli.main(
+        ["batch", *paths, "--outdir", str(outdir), "--dp", "2", "--workers", "4"]
+    ) == 0
+    for p, d in zip(paths, datas):
+        got = read_ints_file(outdir / os.path.basename(p))
+        np.testing.assert_array_equal(got, np.sort(d))
+
+
+def test_cli_batch_rejects_duplicate_basenames(tmp_path):
+    from dsort_tpu import cli
+
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    for d in ("a", "b"):
+        write_ints_file(tmp_path / d / "same.txt", np.arange(5, dtype=np.int32))
+    with pytest.raises(SystemExit, match="duplicate"):
+        cli.main([
+            "batch", str(tmp_path / "a" / "same.txt"),
+            str(tmp_path / "b" / "same.txt"), "--outdir", str(tmp_path / "o"),
+        ])
+
+
+def test_cli_batch_overcommit_clean_error(tmp_path):
+    from dsort_tpu import cli
+
+    src = tmp_path / "x.txt"
+    write_ints_file(src, np.arange(10, dtype=np.int32))
+    with pytest.raises(SystemExit, match="devices"):
+        cli.main([
+            "batch", str(src), "--outdir", str(tmp_path / "o"),
+            "--dp", "2", "--workers", "8",
+        ])
