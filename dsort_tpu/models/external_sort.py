@@ -55,17 +55,26 @@ def _fingerprint(data: np.ndarray, samples: int = 16) -> str:
 
 
 def _overlapped_run_generation(
-    data, n, run_elems, sort_run, ckpt, metrics: Metrics, resume, mapper=None
+    data, n, run_elems, submit_run, fetch_run, ckpt, metrics: Metrics,
+    resume, mapper=None,
 ) -> None:
-    """Sort missing runs with read/compute/write overlap (shared core).
+    """Sort missing runs with read/compute/transfer/write overlap.
 
     The reference's job loop is strictly sequential (read, send, wait,
-    write — ``server.c:171-268``).  Here the next slice's disk read and
-    the previous run's checkpoint write each happen on a background
-    thread while the device sorts the current run, so the pipeline is
-    bounded by max(IO, sort) instead of their sum.  Exceptions from
-    either side surface on the main thread at the next future result.
-    Used by both `ExternalSort` (keys) and `ExternalTeraSort` (records).
+    write — ``server.c:171-268``).  Here four stages pipeline:
+
+    - the next slice's disk read runs on a reader thread;
+    - ``submit_run(chunk)`` dispatches the device sort ASYNCHRONOUSLY and
+      returns an opaque in-flight state (jax dispatch does not block);
+    - ``fetch_run(state)`` materializes the PREVIOUS run's result on host —
+      that device->host transfer overlaps the current run's device work
+      (one run is always in flight);
+    - the finished run's checkpoint write runs on a writer thread.
+
+    So the pipeline is bounded by max(read, sort+transfer overlap, write)
+    instead of their sum.  Exceptions from either side surface on the main
+    thread at the next future result.  Used by both `ExternalSort` (keys)
+    and `ExternalTeraSort` (records).
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -90,15 +99,25 @@ def _overlapped_run_generation(
     ) as writer:
         next_chunk = reader.submit(read_slice, todo[0])
         pending_write = None
+        in_flight: tuple | None = None  # (run_id, device-side state)
+
+        def retire(run_id, state):
+            nonlocal pending_write
+            out = fetch_run(state)
+            if pending_write is not None:
+                pending_write.result()  # surface write errors in order
+            pending_write = writer.submit(ckpt.save, run_id, out)
+            metrics.bump("runs_sorted")
+
         for pos, i in enumerate(todo):
             chunk = next_chunk.result()
             if pos + 1 < len(todo):
                 next_chunk = reader.submit(read_slice, todo[pos + 1])
-            sorted_run = sort_run(chunk)
-            if pending_write is not None:
-                pending_write.result()  # surface write errors in order
-            pending_write = writer.submit(ckpt.save, i, sorted_run)
-            metrics.bump("runs_sorted")
+            state = submit_run(chunk)  # device now busy with run i ...
+            if in_flight is not None:
+                retire(*in_flight)  # ... while run i-1 crosses to the host
+            in_flight = (i, state)
+        retire(*in_flight)
         if pending_write is not None:
             pending_write.result()
 
@@ -185,8 +204,9 @@ class ExternalSort:
             lambda x: sort_with_kernel(x, local_kernel)
         )
 
-    def _sort_run(self, chunk: np.ndarray) -> np.ndarray:
-        """Sort one slice on device behind a fixed padded shape (one compile)."""
+    def _submit_run(self, chunk: np.ndarray):
+        """Dispatch one slice's device sort (async) behind a fixed padded
+        shape (one compile); returns the in-flight (device array, n)."""
         n = len(chunk)
         if n == self.run_elems:
             buf = jnp.asarray(chunk)
@@ -195,7 +215,11 @@ class ExternalSort:
             padded = np.full(self.run_elems, sent, dtype=chunk.dtype)
             padded[:n] = chunk
             buf = jnp.asarray(padded)
-        out = np.asarray(self._sort_fn(buf))
+        return self._sort_fn(buf), n
+
+    def _fetch_run(self, state) -> np.ndarray:
+        y, n = state
+        out = np.asarray(y)
         if n != self.run_elems:
             # Trim is exact even when real keys equal the sentinel: the sort
             # moved exactly (run_elems - n) pads to the tail.
@@ -275,8 +299,8 @@ class ExternalSort:
         self, data, n, num_runs, ckpt, metrics: Metrics, mapper=None
     ) -> None:
         _overlapped_run_generation(
-            data, n, self.run_elems, self._sort_run, ckpt, metrics,
-            resume=self.resume, mapper=mapper,
+            data, n, self.run_elems, self._submit_run, self._fetch_run,
+            ckpt, metrics, resume=self.resume, mapper=mapper,
         )
 
     def _merge(self, runs, out, metrics: Metrics):
@@ -385,8 +409,8 @@ class ExternalTeraSort:
             lambda k, s, v, c: sort_kv2_padded(k, s, v, c, stable=False)[2]
         )
 
-    def _sort_run(self, recs: np.ndarray) -> np.ndarray:
-        """Order one record slice by its full 10-byte key on device."""
+    def _submit_run(self, recs: np.ndarray):
+        """Dispatch one record slice's full-10-byte-key device sort (async)."""
         from dsort_tpu.data.ingest import _pack_be64, terasort_secondary
 
         n = len(recs)
@@ -396,10 +420,14 @@ class ExternalTeraSort:
         k1 = _pack_be64(recs[:, :8])
         # recs[:, 8:] is exactly a TeraSort payload view (key bytes 8-9 first)
         k2 = terasort_secondary(recs[:, 8:]).astype(np.uint16)
-        out = np.asarray(
-            self._sort_fn(jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(recs), n)
+        return (
+            self._sort_fn(jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(recs), n),
+            n,
         )
-        return out[:n]
+
+    def _fetch_run(self, state) -> np.ndarray:
+        y, n = state
+        return np.asarray(y)[:n]
 
     def sort_file(
         self, in_path: str, out_path: str, metrics: Metrics | None = None
@@ -438,8 +466,8 @@ class ExternalTeraSort:
 
     def _generate_runs(self, data, n, num_runs, ckpt, metrics: Metrics) -> None:
         _overlapped_run_generation(
-            data, n, self.run_recs, self._sort_run, ckpt, metrics,
-            resume=self.resume,
+            data, n, self.run_recs, self._submit_run, self._fetch_run,
+            ckpt, metrics, resume=self.resume,
         )
 
     def _merge_runs(self, runs, out, metrics: Metrics) -> None:

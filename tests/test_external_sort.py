@@ -48,14 +48,18 @@ def test_external_resume_skips_finished_runs(tmp_path):
 
 
 def test_external_partial_resume_after_simulated_crash(tmp_path):
-    # Kill the job after 3 runs; the retry sorts only the remaining 7
-    # (SURVEY.md §5.4: strictly better than the reference's restart-the-chunk).
+    # Kill the job at the 4th device submit; the retry sorts only what was
+    # lost (SURVEY.md §5.4: strictly better than the reference's
+    # restart-the-chunk).  The pipeline keeps ONE run in flight (its D2H
+    # overlaps the next run's device work), so a crash at submit k loses
+    # the in-flight run k-1 too: submits 0..2 completed => runs 0..1 are
+    # safely on disk, runs 2..6 re-sort on resume.
     rng = np.random.default_rng(8)
     data = rng.integers(-1000, 1000, 700).astype(np.int32)
     s = ExternalSort(run_elems=100, spill_dir=str(tmp_path), job_id="crash")
 
     calls = {"n": 0}
-    orig = s._sort_run
+    orig = s._submit_run
 
     def dying(chunk):
         if calls["n"] == 3:
@@ -63,14 +67,14 @@ def test_external_partial_resume_after_simulated_crash(tmp_path):
         calls["n"] += 1
         return orig(chunk)
 
-    s._sort_run = dying
+    s._submit_run = dying
     with pytest.raises(RuntimeError, match="injected crash"):
         s.sort(data)
-    s._sort_run = orig
+    s._submit_run = orig
     m = Metrics()
     np.testing.assert_array_equal(s.sort(data, metrics=m), np.sort(data))
-    assert m.counters["runs_resumed"] == 3
-    assert m.counters["runs_sorted"] == 4
+    assert m.counters["runs_resumed"] == 2
+    assert m.counters["runs_sorted"] == 5
 
 
 def test_external_binary_file_roundtrip(tmp_path):
