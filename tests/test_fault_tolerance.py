@@ -88,7 +88,9 @@ def test_hung_worker_detected_by_timeout():
     # The reference blocks forever on a hung worker (no heartbeat, SURVEY.md
     # §5.3); we must declare it dead and reassign.
     inj = FaultInjector()
-    inj.hang_once(0, "sort", seconds=60.0)
+    # Hang long enough to trip the 1 s timeout, short enough that device 0's
+    # shared attempt lane drains before later tests land work on it.
+    inj.hang_once(0, "sort", seconds=4.0)
     # compile_grace_s=0: CPU jits are instant, so the hang (on a cold shape,
     # where real TPU runs get a compile grace window) is detected at the
     # bare heartbeat timeout.
@@ -504,3 +506,66 @@ def test_spmd_shuffle_resume_persists_recovery(mesh8, tmp_path):
     np.testing.assert_array_equal(out3, out1)
     assert m3.counters["shuffle_phase_restores"] == 1
     assert "shuffle_resort_keys" not in m3.counters
+
+
+def test_attempt_threads_bounded_per_worker():
+    """Hung attempts pin at most ONE thread per worker (VERDICT r2 weak #6):
+    repeated hangs on the same worker serialize on its lane instead of
+    spawning a new abandoned thread each time."""
+    import threading
+
+    inj = FaultInjector()
+    job = JobConfig(settle_delay_s=0.01, heartbeat_timeout_s=0.5,
+                    compile_grace_s=0.0)
+    sched = Scheduler(DeviceExecutor(injector=inj), job)
+    data = gen_uniform(4_000, seed=77)
+
+    def lane_threads():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("attempt-d")]
+
+    inj.hang_once(7, "sort", seconds=3.0)
+    sched.run_job(data)  # worker 7 hangs; shard reassigns; job completes
+    inj.hang_once(7, "sort", seconds=3.0)
+    sched.table.revive_all()
+    sched.run_job(data)
+    ts = lane_threads()
+    # lanes are shared per DEVICE process-wide: no matter how many
+    # schedulers or hangs this test session created, at most one attempt
+    # thread exists per device — NOT one per hang or per scheduler
+    import jax
+
+    assert len(ts) <= len(jax.devices())
+    assert all(t.daemon for t in ts)  # a hung lane never blocks process exit
+    import time
+
+    time.sleep(3.5)  # drain device 7's lane so later tests see it healthy
+
+
+def test_abandoned_attempts_never_execute():
+    """A queued attempt whose waiter timed out is SKIPPED when the lane
+    unblocks — stale work must not re-run against later state."""
+    import time
+
+    inj = FaultInjector()
+    job = JobConfig(settle_delay_s=0.01, heartbeat_timeout_s=0.4,
+                    compile_grace_s=0.0)
+    sched = Scheduler(DeviceExecutor(injector=inj), job)
+    data = gen_uniform(4_000, seed=79)
+    calls = []
+    real = sched.executor.sort_shard
+
+    def spy(worker, shard):
+        calls.append(worker)
+        return real(worker, shard)
+
+    sched.executor.sort_shard = spy
+    inj.hang_once(6, "sort", seconds=2.5)
+    out1 = sched.run_job(data)  # worker 6's call hangs; shard reassigns
+    np.testing.assert_array_equal(out1, np.sort(data))
+    n_after_first = calls.count(6)
+    sched.table.revive_all()
+    out2 = sched.run_job(data)  # attempt queues behind the hang, abandons
+    np.testing.assert_array_equal(out2, np.sort(data))
+    time.sleep(3.0)  # hang clears; the abandoned entry must be skipped
+    assert calls.count(6) == n_after_first  # never executed a zombie
