@@ -107,3 +107,36 @@ def test_cli_spmd_mode_routes_small_jobs_fused():
     out2 = sorter(big, m2)
     np.testing.assert_array_equal(out2, np.sort(big))
     assert "fused_small_jobs" not in m2.counters
+
+
+def test_cli_spmd_fused_falls_back_to_scheduler_on_device_error(monkeypatch):
+    """A device-runtime failure on the fused path must retry on the SPMD
+    scheduler (fault tolerance preserved), not crash the CLI."""
+    from dsort_tpu import cli
+    from dsort_tpu.config import SortConfig
+    from dsort_tpu.utils.metrics import Metrics
+
+    import dsort_tpu.models.pipelines as pl
+
+    def dying(data, kernel="auto", metrics=None):
+        from tests.test_fault_tolerance import _xla_error
+
+        raise _xla_error("UNAVAILABLE: device tunnel dropped")
+
+    monkeypatch.setattr(pl, "fused_sort_small", dying)
+    sorter = cli._make_sorter(SortConfig(), "spmd")
+    rng = np.random.default_rng(11)
+    small = rng.integers(0, 10**6, 10_000).astype(np.int32)
+    m = Metrics()
+    out = sorter(small, m)
+    np.testing.assert_array_equal(out, np.sort(small))
+    assert m.counters.get("fused_fallbacks") == 1
+    assert "fused_small_jobs" not in m.counters
+
+    def broken(data, kernel="auto", metrics=None):
+        raise ValueError("INVALID_ARGUMENT: a genuine program bug")
+
+    monkeypatch.setattr(pl, "fused_sort_small", broken)
+    sorter2 = cli._make_sorter(SortConfig(), "spmd")  # closure binds at build
+    with pytest.raises(ValueError):  # program errors must NOT be eaten
+        sorter2(small, Metrics())
