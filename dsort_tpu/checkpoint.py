@@ -17,12 +17,22 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
+import uuid
 
 import numpy as np
 
 
 class ShardCheckpoint:
     """Per-job shard result store keyed by (checkpoint_dir, job_id)."""
+
+    #: Torn tmp files younger than this survive the constructor sweep: a
+    #: fresh tmp may belong to a LIVE concurrent writer sharing this
+    #: (root, job_id) (serve loop + second process, taskpool threads racing
+    #: a new scheduler) and deleting it would break that writer's
+    #: ``os.replace`` (ADVICE r3).  A crashed writer's leftovers are, by the
+    #: time anyone resumes the job, comfortably older.
+    TMP_SWEEP_AGE_S = 60.0
 
     def __init__(self, root: str, job_id: str):
         # Defense in depth against path escape: a job_id like '..' would
@@ -37,13 +47,20 @@ class ShardCheckpoint:
         self.dir = os.path.join(root, job_id)
         os.makedirs(self.dir, exist_ok=True)
         self._manifest_path = os.path.join(self.dir, "manifest.json")
-        # A crash between np.save and os.replace leaves a '*.tmp.npy' (or
-        # 'manifest.json.tmp') behind; sweep them here so a torn write can
-        # never break listing/resume for this job_id (ADVICE r2).
+        # Tmp names carry a per-writer token so two instances sharing
+        # (root, job_id) can never write the same tmp path (ADVICE r3).
+        self._token = f"{os.getpid():x}-{uuid.uuid4().hex[:6]}"
+        # A crash between np.save and os.replace leaves a '*.tmp*' file
+        # behind; sweep STALE ones here so a torn write can never break
+        # listing/resume for this job_id (ADVICE r2).  Fresh tmp files are
+        # left alone — they may belong to a live concurrent writer.
+        now = time.time()
         for name in os.listdir(self.dir):
             if ".tmp" in name:
+                p = os.path.join(self.dir, name)
                 try:
-                    os.remove(os.path.join(self.dir, name))
+                    if now - os.path.getmtime(p) > self.TMP_SWEEP_AGE_S:
+                        os.remove(p)
                 except OSError:
                     pass
 
@@ -51,7 +68,7 @@ class ShardCheckpoint:
         return os.path.join(self.dir, f"shard_{shard_id:05d}.npy")
 
     def write_manifest(self, num_shards: int, dtype, total: int, **extra) -> None:
-        tmp = self._manifest_path + ".tmp"
+        tmp = f"{self._manifest_path}.{self._token}.tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(
                 {"num_shards": num_shards, "dtype": str(np.dtype(dtype)),
@@ -59,6 +76,42 @@ class ShardCheckpoint:
                 f,
             )
         os.replace(tmp, self._manifest_path)
+
+    def sync_manifest(
+        self, num_shards: int, dtype, total: int, fingerprint: str
+    ) -> bool:
+        """THE scheduler-side staleness guard: trust persisted state only if
+        it came from this exact (data, layout); clear otherwise.
+
+        Compares the stored manifest against ``(num_shards, dtype, total,
+        fingerprint)``; on mismatch — or orphaned state with no manifest at
+        all — everything under this job is cleared.  Either way the manifest
+        is (re)written, preserving a matching manifest's ``n_ranges`` record
+        so the shuffle-restore path survives.  Returns True iff stale state
+        was cleared.  Both schedulers call this (one canonical guard — a
+        reused job_id with different same-length data must never serve stale
+        shards; ADVICE r1/r3).
+        """
+        m = self.manifest()
+        have_state = bool(self.completed_shards() or self.completed_ranges())
+        stale = (m is None and have_state) or (
+            m is not None
+            and (
+                m.get("num_shards") != num_shards
+                or m.get("dtype") != str(np.dtype(dtype))
+                or m.get("total") != total
+                or m.get("fingerprint") != fingerprint
+            )
+        )
+        if stale:
+            self.clear()
+        extra = {}
+        if not stale and m is not None and "n_ranges" in m:
+            extra["n_ranges"] = m["n_ranges"]
+        self.write_manifest(
+            num_shards, dtype, total, fingerprint=fingerprint, **extra
+        )
+        return stale
 
     def manifest(self) -> dict | None:
         try:
@@ -72,8 +125,9 @@ class ShardCheckpoint:
 
     def save(self, shard_id: int, arr: np.ndarray) -> None:
         # Write-then-rename so a crash mid-save never yields a torn shard.
+        # The `.npy` suffix keeps np.save from appending its own.
         path = self._shard_path(shard_id)
-        tmp = path + ".tmp.npy"
+        tmp = f"{path}.{self._token}.tmp.npy"
         np.save(tmp, np.asarray(arr))
         os.replace(tmp, path)
 
@@ -104,7 +158,7 @@ class ShardCheckpoint:
 
     def save_range(self, range_id: int, arr: np.ndarray) -> None:
         path = self._range_path(range_id)
-        tmp = path + ".tmp.npy"
+        tmp = f"{path}.{self._token}.tmp.npy"
         np.save(tmp, np.asarray(arr))
         os.replace(tmp, path)
 

@@ -148,6 +148,12 @@ class _AttemptLane:
         return box, done, abandoned
 
 
+# Lanes are created on first use and NEVER reclaimed: one daemon thread per
+# ever-seen device for process lifetime is the deliberate cost of hang
+# containment (the thread may be wedged inside a device call that cannot be
+# killed, so "reclaiming" it is impossible anyway).  Bounded by the device
+# count of this process's platform; if devices ever churn dynamically
+# (multi-host growth), this registry grows with the union of devices seen.
 _DEVICE_LANES: dict = {}
 _DEVICE_LANES_LOCK = threading.Lock()
 
@@ -175,7 +181,28 @@ class Scheduler:
             # job's kernel choice wins over the executor's construction-time
             # default.
             executor.set_kernel(self.job.local_kernel)
-        self._warm_shapes: set = set()  # (shape, dtype) combos already compiled
+        # (device, shape, dtype, kernel) combos whose executable is known
+        # compiled ON that device: jit caches one executable per device, so
+        # warming a shape on worker 0 says nothing about worker 1's first
+        # attempt (a revived worker or an odd last shard reassigned to a new
+        # device still pays the full 30-150 s compile — ADVICE r3).
+        self._warm_shapes: set = set()
+
+    def _warm_key(self, worker: int, shard: np.ndarray) -> tuple:
+        return (
+            self.executor.devices[worker],
+            shard.shape,
+            str(shard.dtype),
+            self.executor.kernel,
+        )
+
+    def _attempt_timeout(self, worker: int, shard: np.ndarray) -> float:
+        return self._timeout_for(self._warm_key(worker, shard))
+
+    def _timeout_for(self, warm_key: tuple) -> float:
+        return self.job.heartbeat_timeout_s + (
+            0.0 if warm_key in self._warm_shapes else self.job.compile_grace_s
+        )
 
     def _attempt(self, worker: int, shard: np.ndarray) -> np.ndarray:
         """One exchange attempt on one worker, bounded by the heartbeat timeout.
@@ -195,14 +222,12 @@ class Scheduler:
         box, done, abandoned = lane.submit(
             functools.partial(self.executor.sort_shard, worker, shard)
         )
-        # A cold (shape, dtype) pays XLA/Mosaic compilation inside the
-        # attempt (30-150 s through a remote compiler) — that must not read
-        # as a hung worker, so the first attempt per combo gets extra grace.
-        key = (shard.shape, str(shard.dtype), self.executor.kernel)
-        timeout = self.job.heartbeat_timeout_s + (
-            0.0 if key in self._warm_shapes else self.job.compile_grace_s
-        )
-        if not done.wait(timeout=timeout):
+        # A cold (device, shape, dtype) pays XLA/Mosaic compilation inside
+        # the attempt (30-150 s through a remote compiler) — that must not
+        # read as a hung worker, so the first attempt per combo gets extra
+        # grace, independently per device.
+        key = self._warm_key(worker, shard)
+        if not done.wait(timeout=self._timeout_for(key)):
             abandoned.set()  # if still queued, it will be skipped, not run
             raise TimeoutError(f"worker {worker} heartbeat timeout")
         if "e" in box:
@@ -310,9 +335,18 @@ class Scheduler:
         ckpt = None
         if self.job.checkpoint_dir and job_id:
             from dsort_tpu.checkpoint import ShardCheckpoint
+            from dsort_tpu.models.external_sort import _fingerprint
 
             ckpt = ShardCheckpoint(self.job.checkpoint_dir, job_id)
-            ckpt.write_manifest(w, np.asarray(data).dtype, len(data))
+            # Shards outlive successful runs and the CLI derives job_id from
+            # the input basename, so a re-run after the file's contents (or
+            # the worker count) changed must not serve stale shards
+            # (ADVICE r3; same canonical guard as SpmdScheduler.sort).
+            if ckpt.sync_manifest(w, data.dtype, len(data), _fingerprint(data)):
+                log.warning(
+                    "job %r: checkpointed shards belong to different data or "
+                    "layout; cleared", job_id,
+                )
         with timer.phase("partition"):
             shards = partition(np.asarray(data), w)
         results: list[np.ndarray | None] = [None] * w
@@ -573,36 +607,17 @@ class SpmdScheduler:
             from dsort_tpu.models.external_sort import _fingerprint
 
             ckpt = ShardCheckpoint(self.job.checkpoint_dir, job_id)
-            # Trust checkpointed state only if it came from THIS data: a
-            # reused job_id with different same-length data must not serve
-            # stale shards/ranges (same guard as ExternalSort's
-            # _sync_manifest — ADVICE r1).
-            fp = _fingerprint(data)
-            m = ckpt.manifest()
-            have_state = bool(ckpt.completed_shards() or ckpt.completed_ranges())
-            stale = (m is None and have_state) or (
-                m is not None
-                and (
-                    m.get("num_shards") != len(self.devices)
-                    or m.get("dtype") != str(np.asarray(data).dtype)
-                    or m.get("total") != len(data)
-                    or m.get("fingerprint") != fp
-                )
-            )
-            if stale:
+            # A reused job_id with different same-length data must not serve
+            # stale shards/ranges (ADVICE r1; one canonical guard shared
+            # with the taskpool scheduler — sync_manifest also preserves a
+            # matching manifest's n_ranges shuffle record).
+            if ckpt.sync_manifest(
+                len(self.devices), data.dtype, len(data), _fingerprint(data)
+            ):
                 log.warning(
                     "job %r: checkpointed state belongs to different data; "
-                    "clearing",
-                    job_id,
+                    "cleared", job_id,
                 )
-                ckpt.clear()
-            extra = {}
-            if not stale and m is not None and "n_ranges" in m:
-                extra["n_ranges"] = m["n_ranges"]  # keep the shuffle record
-            ckpt.write_manifest(
-                len(self.devices), np.asarray(data).dtype, len(data),
-                fingerprint=fp, **extra,
-            )
         transient_retries = 0
         while True:
             live = self.table.live_workers()

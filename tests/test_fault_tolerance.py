@@ -464,24 +464,94 @@ def test_taskpool_cancelled_escalates_after_budget(monkeypatch):
 
 def test_checkpoint_ignores_torn_tmp_files(tmp_path):
     """A crash between np.save and os.replace leaves '*.tmp.npy' files; they
-    must neither crash listing nor be served as results (ADVICE r2)."""
+    must neither crash listing nor be served as results (ADVICE r2).  Only
+    STALE tmp files are swept: a fresh one may belong to a live concurrent
+    writer sharing the job dir (ADVICE r3)."""
+    import os
+    import time
+
     from dsort_tpu.checkpoint import ShardCheckpoint
 
     ckpt = ShardCheckpoint(str(tmp_path), "torn")
     ckpt.save(0, np.arange(4, dtype=np.int32))
     ckpt.save_range(0, np.arange(4, dtype=np.int32))
-    import os
 
-    for name in ("shard_00001.npy.tmp.npy", "range_00001.npy.tmp.npy",
-                 "manifest.json.tmp"):
+    torn = ("shard_00001.npy.tmp.npy", "range_00001.npy.tmp.npy",
+            "manifest.json.tmp")
+    for name in torn + ("fresh_inflight.npy.tmp.npy",):
         with open(os.path.join(ckpt.dir, name), "wb") as f:
             f.write(b"torn")
+    old = time.time() - ShardCheckpoint.TMP_SWEEP_AGE_S - 5
+    for name in torn:  # crashed-writer leftovers are old by resume time
+        os.utime(os.path.join(ckpt.dir, name), (old, old))
     assert ckpt.completed_shards() == [0]
     assert ckpt.completed_ranges() == [0]
-    # a fresh handle (the next run) sweeps the torn leftovers
+    # a fresh handle (the next run) sweeps the stale leftovers only
     ckpt2 = ShardCheckpoint(str(tmp_path), "torn")
-    assert not any(".tmp" in n for n in os.listdir(ckpt2.dir))
+    left = [n for n in os.listdir(ckpt2.dir) if ".tmp" in n]
+    assert left == ["fresh_inflight.npy.tmp.npy"]  # live writer untouched
     assert ckpt2.completed_shards() == [0]
+
+
+def test_checkpoint_tmp_names_unique_per_writer(tmp_path):
+    """Two instances sharing (root, job_id) never collide on tmp paths, so a
+    concurrent writer's in-flight tmp cannot be replaced out from under it
+    (ADVICE r3)."""
+    from dsort_tpu.checkpoint import ShardCheckpoint
+
+    a = ShardCheckpoint(str(tmp_path), "dup")
+    b = ShardCheckpoint(str(tmp_path), "dup")
+    assert a._token != b._token
+    a.save(0, np.arange(8, dtype=np.int32))
+    b.save(0, np.arange(8, dtype=np.int32)[::-1].copy())
+    np.testing.assert_array_equal(a.load(0), np.arange(8, dtype=np.int32)[::-1])
+
+
+def test_taskpool_stale_checkpoint_cleared(tmp_path):
+    """Re-running `run_job` under the same job_id with DIFFERENT data must
+    not serve the previous run's persisted shards (ADVICE r3: the taskpool
+    path now carries the same fingerprint guard as SpmdScheduler.sort)."""
+    job = JobConfig(settle_delay_s=0.01, checkpoint_dir=str(tmp_path))
+    sched = Scheduler(DeviceExecutor(), job)
+    a = gen_uniform(20_000, seed=81)
+    out_a = sched.run_job(a, job_id="reused")
+    np.testing.assert_array_equal(out_a, np.sort(a))
+    # Same length, same dtype, different contents — only the fingerprint
+    # distinguishes them, exactly the `dsort run FILE` re-run scenario.
+    b = gen_uniform(20_000, seed=82)
+    m = Metrics()
+    out_b = sched.run_job(b, metrics=m, job_id="reused")
+    np.testing.assert_array_equal(out_b, np.sort(b))
+    assert "shards_restored" not in m.counters  # stale state was cleared
+
+
+def test_taskpool_same_data_reuses_checkpoint(tmp_path):
+    """The guard must not break legitimate resume: identical data under the
+    same job_id still restores completed shards."""
+    job = JobConfig(settle_delay_s=0.01, checkpoint_dir=str(tmp_path))
+    sched = Scheduler(DeviceExecutor(), job)
+    a = gen_uniform(20_000, seed=83)
+    sched.run_job(a, job_id="samejob")
+    m = Metrics()
+    out = sched.run_job(a, metrics=m, job_id="samejob")
+    np.testing.assert_array_equal(out, np.sort(a))
+    assert m.counters["shards_restored"] == sched.executor.num_workers
+
+
+def test_warm_shapes_keyed_per_device():
+    """Compile grace is granted per (device, shape, dtype, kernel): warming a
+    shape on worker 0 must not strip worker 1's first-attempt grace (ADVICE
+    r3 — jit executables compile per device, so a worker revived for job 2
+    or a shard reassigned to a fresh device still pays the full compile)."""
+    job = JobConfig(settle_delay_s=0.01, heartbeat_timeout_s=1.0,
+                    compile_grace_s=100.0)
+    sched = Scheduler(DeviceExecutor(), job)
+    shard = gen_uniform(1_000, seed=84)
+    assert sched._attempt_timeout(0, shard) == pytest.approx(101.0)
+    sched._attempt(0, shard)  # warms (device 0, shape, dtype, kernel)
+    assert sched._attempt_timeout(0, shard) == pytest.approx(1.0)
+    # same shape on a different device is still cold
+    assert sched._attempt_timeout(1, shard) == pytest.approx(101.0)
 
 
 def test_spmd_shuffle_resume_persists_recovery(mesh8, tmp_path):
