@@ -93,7 +93,7 @@ class JobConfig:
     key_dtype: Any = jnp.int32
     payload_bytes: int = 0          # 0 → key-only sort; >0 → TeraSort-style records
     local_kernel: str = "auto"      # per-chip sort: "auto" | "lax" | "block" | "bitonic" | "pallas" | "radix"
-    merge_kernel: str = "sort"      # post-shuffle combine: "sort" | "bitonic"
+    merge_kernel: str = "sort"      # post-shuffle combine: "sort" | "bitonic" | "block_merge"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
     # Per-(src,dst) all_to_all bucket headroom over the ideal n/P split.
@@ -113,6 +113,18 @@ class JobConfig:
     # genuinely hung worker on a cold shape is still detected, just slower.
     compile_grace_s: float = 240.0
     max_transient_retries: int = 2  # real runtime error, all devices healthy
+    # In-flight SPMD/fused program hang detection (the reference's signature
+    # blind spot, SURVEY.md §5.3: a worker that hangs without closing its
+    # socket blocks server.c forever).  The whole-program wait is bounded by
+    #   heartbeat_timeout_s + exec_allowance_floor_s
+    #     + n_keys / exec_allowance_keys_per_s
+    #     (+ compile_grace_s while this (mesh, size-bucket) is cold).
+    # The 1 Mkeys/s allowance rate is ~1000x slower than the chip actually
+    # sorts, so only a genuine hang trips the timeout; on lapse every device
+    # is probed, the dead are excluded, and the job re-runs on the re-formed
+    # mesh from the last checkpointed phase.
+    exec_allowance_floor_s: float = 30.0
+    exec_allowance_keys_per_s: float = 1e6
     checkpoint_dir: str | None = None  # persist sorted shards for partial recovery
 
     def __post_init__(self) -> None:
@@ -131,9 +143,10 @@ class JobConfig:
             raise ConfigError(
                 f"local_kernel must be one of {LOCAL_KERNELS}, got {self.local_kernel!r}"
             )
-        if self.merge_kernel not in ("sort", "bitonic"):
+        if self.merge_kernel not in ("sort", "bitonic", "block_merge"):
             raise ConfigError(
-                f"merge_kernel must be 'sort' or 'bitonic', got {self.merge_kernel!r}"
+                "merge_kernel must be 'sort', 'bitonic' or 'block_merge', "
+                f"got {self.merge_kernel!r}"
             )
         if self.oversample < 1:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")
@@ -142,6 +155,15 @@ class JobConfig:
         if self.max_transient_retries < 0:
             raise ConfigError(
                 f"max_transient_retries must be >= 0, got {self.max_transient_retries}"
+            )
+        if self.exec_allowance_floor_s < 0:
+            raise ConfigError(
+                f"exec_allowance_floor_s must be >= 0, got {self.exec_allowance_floor_s}"
+            )
+        if self.exec_allowance_keys_per_s <= 0:
+            raise ConfigError(
+                "exec_allowance_keys_per_s must be > 0, got "
+                f"{self.exec_allowance_keys_per_s}"
             )
 
 

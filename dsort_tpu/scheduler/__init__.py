@@ -2,8 +2,10 @@
 
 from dsort_tpu.scheduler.liveness import WorkerState, WorkerTable  # noqa: F401
 from dsort_tpu.scheduler.fault import (  # noqa: F401
+    AttemptCancelled,
     FaultInjector,
     JobFailedError,
+    ProgramWaitTimeout,
     WorkerFailure,
 )
 from dsort_tpu.scheduler.scheduler import (  # noqa: F401

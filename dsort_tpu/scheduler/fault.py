@@ -22,6 +22,26 @@ class WorkerFailure(RuntimeError):
         self.stage = stage
 
 
+class ProgramWaitTimeout(TimeoutError):
+    """The bounded in-flight program wait lapsed (SPMD/fused hang detection).
+
+    A dedicated subclass so recovery never conflates it with a genuine
+    ``TimeoutError``/``socket.timeout`` raised *inside* the attempt (e.g.
+    checkpoint IO on a network filesystem) — those propagate as ordinary
+    errors instead of triggering device probes.
+    """
+
+
+class AttemptCancelled(RuntimeError):
+    """Raised inside an abandoned attempt at its next cancellation check.
+
+    After a bounded wait lapses, the stale attempt may still be running on
+    its lane; every state-mutating step (checkpoint writes, shared-variable
+    assignment) first checks the cancel event so a late-waking zombie cannot
+    interleave writes with the re-formed mesh's live attempt.
+    """
+
+
 class JobFailedError(RuntimeError):
     """No live workers remain; the job fails cleanly, the cluster survives.
 

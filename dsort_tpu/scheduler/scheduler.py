@@ -40,8 +40,10 @@ from dsort_tpu.data.partition import partition
 from dsort_tpu.ops.float_order import is_float_key_dtype, sort_float_keys_via_uint
 from dsort_tpu.ops.merge import merge_sorted_host
 from dsort_tpu.scheduler.fault import (
+    AttemptCancelled,
     FaultInjector,
     JobFailedError,
+    ProgramWaitTimeout,
     WorkerFailure,
     classify_runtime_error,
 )
@@ -164,6 +166,11 @@ def _lane_for_device(dev) -> _AttemptLane:
         if lane is None:
             lane = _DEVICE_LANES[dev] = _AttemptLane(f"attempt-d{dev.id}")
         return lane
+
+
+def _size_bucket(n: int) -> int:
+    """Power-of-two size class — the granularity of wait-budget warm-up."""
+    return 1 << max(int(n - 1).bit_length(), 0) if n > 1 else 1
 
 
 class Scheduler:
@@ -398,6 +405,31 @@ class SpmdScheduler:
         self.axis = axis_name
         self.table = WorkerTable(len(self.devices), self.job.heartbeat_timeout_s)
         self._sorters: dict[tuple, object] = {}  # device-id set -> SampleSort
+        # (lane key, size bucket) combos that completed once: their compiled
+        # executables exist, so later waits drop the compile grace.
+        self._warm_waits: set = set()
+        # Whole-program lanes (SPMD collective / fused small-job attempts),
+        # keyed by (tag, device-id tuple).  SEPARATE from the per-device
+        # lanes: after an in-flight timeout the scheduler probes every
+        # device, and a probe queued behind the hung whole-mesh program on a
+        # shared lane would time out and falsely kill a healthy device.
+        # Per-scheduler (not module-global): the lane serializes THIS
+        # scheduler's attempts; a fresh scheduler must not queue behind an
+        # abandoned program from a dead one.  Growth is bounded by the
+        # distinct meshes this scheduler ever forms (each re-form shrinks
+        # the device set); entries are never reclaimed — a wedged program's
+        # thread can't be killed anyway.
+        self._mesh_lanes: dict = {}
+        self._mesh_lanes_lock = threading.Lock()
+
+    def _mesh_lane(self, key: tuple) -> _AttemptLane:
+        with self._mesh_lanes_lock:
+            lane = self._mesh_lanes.get(key)
+            if lane is None:
+                lane = self._mesh_lanes[key] = _AttemptLane(
+                    f"prog-{key[0]}-{len(self._mesh_lanes)}"
+                )
+            return lane
 
     def _live_devices(self) -> list[jax.Device]:
         return [self.devices[i] for i in self.table.live_workers()]
@@ -416,6 +448,10 @@ class SpmdScheduler:
         times out here too — correctly: the device is not serving work.
         """
         def probe():
+            if self.injector is not None:
+                # Lets tests (and drills) model a device that is wedged for
+                # probes too, not just for dispatch.
+                self.injector.check(idx, "probe")
             y = jax.device_put(np.zeros(8, np.int32), self.devices[idx])
             return int(np.asarray(y).sum()) == 0
 
@@ -447,8 +483,27 @@ class SpmdScheduler:
             metrics.bump("device_deaths", len(dead))
         return dead
 
+    @staticmethod
+    def _check_cancelled(cancelled: threading.Event | None) -> None:
+        """Abandoned-attempt guard before every state-mutating step.
+
+        A lapsed bounded wait abandons its attempt, but the attempt thread
+        may still be running (wedged in a device call that later unwedges).
+        Checking the cancel event immediately before each checkpoint write /
+        shared assignment means a zombie can never interleave its stale
+        layout (old mesh size, old n_ranges) with the live attempt's state.
+        Residual window: a zombie already *inside* an atomic single-file
+        write when cancellation lands completes that one write; the live
+        attempt clears leftover ranges before writing its own, so a torn
+        mix requires the zombie to wake mid-loop after that clear — accepted
+        as unreachable in practice and bounded to one file.
+        """
+        if cancelled is not None and cancelled.is_set():
+            raise AttemptCancelled("attempt abandoned by bounded wait")
+
     def _local_sort_phase(
-        self, data: np.ndarray, ckpt, metrics: Metrics
+        self, data: np.ndarray, ckpt, metrics: Metrics,
+        cancelled: threading.Event | None = None,
     ) -> np.ndarray:
         """Phase A: per-shard local sort, persisted at the phase boundary.
 
@@ -475,13 +530,15 @@ class SpmdScheduler:
             host = np.asarray(sorted_shards)
             for i in range(w):
                 if i not in done:
+                    self._check_cancelled(cancelled)
                     ckpt.save(i, host[i, : counts[i]])
         else:
             metrics.bump("spmd_phase_restores")
         return np.concatenate([ckpt.load(i) for i in range(w)])
 
     def _shuffle_with_range_checkpoint(
-        self, work: np.ndarray, ckpt, ss, metrics: Metrics, live: list[int]
+        self, work: np.ndarray, ckpt, ss, metrics: Metrics, live: list[int],
+        cancelled: threading.Event | None = None,
     ) -> np.ndarray:
         """Phase B with per-range persistence (SURVEY.md §5.4, upgraded).
 
@@ -502,8 +559,16 @@ class SpmdScheduler:
                 return np.concatenate(
                     [ckpt.load_range(i) for i in sorted(done)]
                 )
-            return self._resume_missing_ranges(work, ckpt, ss, done, metrics)
+            return self._resume_missing_ranges(
+                work, ckpt, ss, done, metrics, cancelled
+            )
         outs = ss.sort_ranges(work, metrics)
+        self._check_cancelled(cancelled)
+        # Drop leftover range files before recording the fresh layout: an
+        # abandoned attempt (or torn earlier run) may have persisted ranges
+        # under a DIFFERENT mesh size whose ids would otherwise mix with
+        # this run's on the next resume.
+        ckpt.clear_ranges()
         ckpt.write_manifest(
             man.get("num_shards", len(self.devices)),
             work.dtype,
@@ -516,11 +581,13 @@ class SpmdScheduler:
             # back — ranges 0..i-1 are already safe on disk.
             if self.injector is not None:
                 self.injector.check(live[min(i, len(live) - 1)], "assemble")
+            self._check_cancelled(cancelled)
             ckpt.save_range(i, r)
         return np.concatenate(outs)
 
     def _resume_missing_ranges(
-        self, work: np.ndarray, ckpt, ss, done: list[int], metrics: Metrics
+        self, work: np.ndarray, ckpt, ss, done: list[int], metrics: Metrics,
+        cancelled: threading.Event | None = None,
     ) -> np.ndarray:
         """Re-sort only the key intervals whose ranges were lost.
 
@@ -571,6 +638,7 @@ class SpmdScheduler:
         # (ADVICE r2).  Write order is crash-safe: clearing first means a
         # crash mid-rewrite leaves either no ranges (full re-shuffle) or a
         # single all-covering range (resume re-derives an empty subset).
+        self._check_cancelled(cancelled)
         man = ckpt.manifest() or {}
         ckpt.clear_ranges()
         ckpt.save_range(0, out)
@@ -582,6 +650,61 @@ class SpmdScheduler:
             n_ranges=1,
         )
         return out
+
+    def _wait_budget(self, n_keys: int, warm: bool) -> float:
+        j = self.job
+        b = (
+            j.heartbeat_timeout_s
+            + j.exec_allowance_floor_s
+            + n_keys / j.exec_allowance_keys_per_s
+        )
+        return b if warm else b + j.compile_grace_s
+
+    def run_bounded(
+        self, fn, n_keys: int, tag: str = "prog", lane_key=None,
+        cancel_event: threading.Event | None = None,
+    ):
+        """Run a whole device program under the bounded-wait discipline.
+
+        The README's heartbeat claim, made true in the flagship mode
+        (VERDICT r3 #1): ``fn`` — an entire SPMD collective or fused
+        small-job program — runs on a dedicated mesh lane (daemon thread,
+        see `_mesh_lanes`), and the caller waits at most `_wait_budget`
+        (heartbeat + size-scaled execution allowance + compile grace while
+        this (mesh, size-bucket) is cold).  On lapse the attempt is
+        abandoned, ``cancel_event`` (if given) is set so a late-waking
+        zombie attempt stops before mutating shared state, and
+        `ProgramWaitTimeout` is raised — the caller probes devices and
+        re-forms, so a chip that wedges mid-collective can no longer freeze
+        ``dsort run`` forever the way it freezes the reference
+        (``server.c:358,421`` detect errors only, never hangs).  A genuine
+        ``TimeoutError`` raised *inside* ``fn`` re-raises as itself and is
+        NOT treated as a lapsed wait.
+
+        Known trade-off, chosen deliberately: a warm size bucket that still
+        triggers a fresh compile (a capacity retry compiling a new cap_pair
+        on skewed data) eats into the allowance and can false-timeout; the
+        retry then queues behind the still-compiling attempt on the same
+        lane and completes from the warmed executable, so the job converges
+        — it just pays one spurious probe round.
+        """
+        key = lane_key if lane_key is not None else (
+            (tag,) + tuple(d.id for d in self.devices)
+        )
+        warm = (key, _size_bucket(n_keys))
+        budget = self._wait_budget(n_keys, warm in self._warm_waits)
+        box, done, abandoned = self._mesh_lane(key).submit(fn)
+        if not done.wait(timeout=budget):
+            abandoned.set()
+            if cancel_event is not None:
+                cancel_event.set()
+            raise ProgramWaitTimeout(
+                f"in-flight program wait exceeded {budget:.1f}s on {key[0]}"
+            )
+        if "e" in box:
+            raise box["e"]
+        self._warm_waits.add(warm)
+        return box["r"]
 
     def sort(
         self,
@@ -624,7 +747,16 @@ class SpmdScheduler:
             if not live:
                 raise JobFailedError("job failed: no live devices remain")
             devs = [self.devices[i] for i in live]
-            try:
+            cancelled = threading.Event()
+
+            def attempt():
+                # The WHOLE attempt — checkpointed phases, dispatch, and the
+                # blocking device fetch inside SampleSort — runs on the mesh
+                # lane, so a hang anywhere in flight is caught by the
+                # bounded wait in `run_bounded`, not just surfaced errors.
+                # `cancelled` (set when the wait lapses) gates every state
+                # mutation so a zombie attempt can't race its successor.
+                nonlocal work
                 if ckpt is not None:
                     # Full restore (every shuffle range on disk) never reads
                     # `work`: skip the local-sort phase's full-dataset shard
@@ -635,7 +767,9 @@ class SpmdScheduler:
                         and len(ckpt.completed_ranges()) == man0["n_ranges"]
                     )
                     if not full_restore:
-                        work = self._local_sort_phase(data, ckpt, metrics)
+                        w = self._local_sort_phase(data, ckpt, metrics, cancelled)
+                        self._check_cancelled(cancelled)
+                        work = w
                 # Injection point models a device lost in the shuffle phase —
                 # i.e. after the checkpointed local-sort phase boundary.
                 if self.injector is not None:
@@ -651,11 +785,17 @@ class SpmdScheduler:
                     mesh = Mesh(np.array(devs), (self.axis,))
                     ss = self._sorters[key] = SampleSort(mesh, self.job, self.axis)
                 if ckpt is None:
-                    out = ss.sort(work, metrics)
-                else:
-                    out = self._shuffle_with_range_checkpoint(
-                        work, ckpt, ss, metrics, live
-                    )
+                    return ss.sort(work, metrics)
+                return self._shuffle_with_range_checkpoint(
+                    work, ckpt, ss, metrics, live, cancelled
+                )
+
+            try:
+                out = self.run_bounded(
+                    attempt, len(data), tag="spmd",
+                    lane_key=("spmd",) + tuple(d.id for d in devs),
+                    cancel_event=cancelled,
+                )
                 for i in live:  # proof of life: the collective completed
                     self.table.heartbeat(i)
                 return out
@@ -666,6 +806,34 @@ class SpmdScheduler:
                 )
                 self.table.mark_dead(e.worker)
                 metrics.bump("mesh_reforms")
+                time.sleep(self.job.settle_delay_s)
+            except ProgramWaitTimeout as e:
+                # The in-flight program wait lapsed — the hang the reference
+                # can never detect (SURVEY.md §5.3).  Probe every device to
+                # find wedged participants; with all devices healthy it was
+                # a host-side stall — retry a bounded number of times.
+                # (A genuine TimeoutError from inside the attempt — e.g.
+                # checkpoint IO on a network mount — is NOT this type and
+                # propagates through the generic handler below.)
+                metrics.bump("spmd_wait_timeouts")
+                dead = self._reap_after_runtime_error(live, metrics)
+                if dead:
+                    log.warning(
+                        "in-flight wait timed out (%s); devices %s dead, "
+                        "re-forming mesh over %d survivors",
+                        e, dead, len(live) - len(dead),
+                    )
+                    metrics.bump("mesh_reforms")
+                elif transient_retries < self.job.max_transient_retries:
+                    transient_retries += 1
+                    metrics.bump("transient_retries")
+                    log.warning(
+                        "in-flight wait timed out with all devices healthy "
+                        "(retry %d/%d): %s",
+                        transient_retries, self.job.max_transient_retries, e,
+                    )
+                else:
+                    raise
                 time.sleep(self.job.settle_delay_s)
             except Exception as e:
                 # A *real* runtime failure from the mesh (XLA reports one

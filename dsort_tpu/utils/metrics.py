@@ -10,13 +10,19 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 import time
 from collections import defaultdict
 
 
 @dataclasses.dataclass
 class Metrics:
-    """Accumulated per-phase wall times and counters for one job."""
+    """Accumulated per-phase wall times and counters for one job.
+
+    Lock-protected: taskpool shard handlers and (rarely) an abandoned SPMD
+    attempt overlapping its successor can bump the same instance from
+    multiple threads, and dict read-modify-write is not atomic.
+    """
 
     phase_s: dict[str, float] = dataclasses.field(
         default_factory=lambda: defaultdict(float)
@@ -24,12 +30,17 @@ class Metrics:
     counters: dict[str, int] = dataclasses.field(
         default_factory=lambda: defaultdict(int)
     )
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def add(self, phase: str, seconds: float) -> None:
-        self.phase_s[phase] += seconds
+        with self._lock:
+            self.phase_s[phase] += seconds
 
     def bump(self, counter: str, by: int = 1) -> None:
-        self.counters[counter] += by
+        with self._lock:
+            self.counters[counter] += by
 
     def total_s(self) -> float:
         return sum(self.phase_s.values())
