@@ -416,8 +416,8 @@ def _level_pass(xs, asc_rows, m_top: int, rows: int, span_rows: int,
                          asc_top=asc_rows)
 
 
-def _span_low_kernel(*refs, rows: int, m_hi: int, np_: int):
-    """Fused LOW merge levels: kb = 2 .. 2*m_hi complete in ONE pass.
+def _span_low_kernel(*refs, rows: int, m_hi: int, np_: int, kb_start: int = 2):
+    """Fused LOW merge levels: kb = ``kb_start`` .. 2*m_hi complete in ONE pass.
 
     Every exchange of a level ``kb <= 2*m_hi`` pairs blocks at distances
     ``<= m_hi``, i.e. strictly inside an aligned ``2*m_hi``-block window
@@ -427,6 +427,10 @@ def _span_low_kernel(*refs, rows: int, m_hi: int, np_: int):
     passes (kb=2,4,8,16) with one — 3 fewer HBM round trips — and, because
     every ``kb`` here is static, the predicated no-op stages the runtime-
     parametrized span-tail pays at low levels vanish.
+
+    ``kb_start > 2`` is the merge-runs entry (`block_merge_runs`): levels
+    below ``kb_start`` are skipped because the input already consists of
+    sorted runs of ``kb_start/2`` blocks, alternately directed.
     """
     import jax.experimental.pallas as pl
 
@@ -436,7 +440,7 @@ def _span_low_kernel(*refs, rows: int, m_hi: int, np_: int):
     blk = pl.program_id(0) * span + rowi_span // rows
     lane = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 1)
     rowi = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 0)
-    kb = 2
+    kb = kb_start
     while kb <= span:
         asc_rows = (blk & kb) == 0  # per-block direction, constant per pair
         xs = _level_pass(xs, asc_rows, kb // 2, rows, span * rows, lane, rowi)
@@ -445,8 +449,10 @@ def _span_low_kernel(*refs, rows: int, m_hi: int, np_: int):
         o_ref[:] = x
 
 
-@functools.partial(jax.jit, static_argnames=("rows", "m_hi", "interpret"))
-def _span_low(xs, rows: int, m_hi: int, interpret: bool):
+@functools.partial(
+    jax.jit, static_argnames=("rows", "m_hi", "interpret", "kb_start")
+)
+def _span_low(xs, rows: int, m_hi: int, interpret: bool, kb_start: int = 2):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -458,7 +464,8 @@ def _span_low(xs, rows: int, m_hi: int, interpret: bool):
     with jax.enable_x64(False):  # see _tile_sort_cm
         out = pl.pallas_call(
             functools.partial(
-                _span_low_kernel, rows=rows, m_hi=m_hi, np_=len(xs)
+                _span_low_kernel, rows=rows, m_hi=m_hi, np_=len(xs),
+                kb_start=kb_start,
             ),
             out_shape=_shapes(xs),
             grid=(t,),
@@ -638,6 +645,229 @@ def _sort_planes(
         xs = _as_tuple(_span_tail(xs, kb, blk, span_m, interpret), nplanes)
         k *= 2
     return xs
+
+
+def _merge_planes(
+    planes: tuple, p: int, run_len: int, block_rows: int, interpret: bool
+) -> tuple:
+    """Run ONLY the merge levels ``2*run_len .. p`` over pre-sorted runs.
+
+    ``planes`` hold ``p // run_len`` runs of ``run_len`` elements each,
+    already sorted ascending iff their run index is even (the caller flips
+    odd runs).  This is the bitonic network entered mid-way: K1's 153-stage
+    tile sort — the dominant pass of the full `block_sort` — never runs.
+    For the SPMD post-shuffle shape (P=8 runs of one block each) the whole
+    merge is a single span-resident pass of ~3 levels vs the full re-sort's
+    K1 + span_low.
+    """
+    nplanes = len(planes)
+    total_rows = p // LANES
+    cap = min(block_rows, total_rows)
+    b = cap * LANES
+    xs = planes
+    k0 = 2 * run_len
+    if k0 <= b:
+        # Finish every block from the run level up in one pass; blocks
+        # emerge alternately directed for the span machinery above.
+        xs = _as_tuple(_sort_levels(xs, cap, k0, p > b, interpret), nplanes)
+        k0 = 2 * b
+    t_blocks = total_rows // cap
+    if t_blocks <= 1:
+        return xs
+    span_m_hi = max(SPAN_M_HI // nplanes, 1)
+    span_m = max(min(span_m_hi, t_blocks // 2), 1)
+    span = 2 * span_m
+    kb0 = k0 // b
+    if kb0 <= span:
+        xs = _as_tuple(
+            _span_low(xs, cap, span_m, interpret, kb_start=kb0), nplanes
+        )
+        k = 2 * span * b
+    else:
+        k = k0
+    while k <= p:
+        kb = jnp.full((1, 1), k // b, jnp.int32)
+        m = k // (2 * b)
+        while m > span_m:
+            xs = _as_tuple(_cross(xs, kb, cap, m, interpret), nplanes)
+            m //= 2
+        xs = _as_tuple(_span_tail(xs, kb, cap, span_m, interpret), nplanes)
+        k *= 2
+    return xs
+
+
+def _flip_odd_rows(arr: jax.Array) -> jax.Array:
+    """Reverse every odd row — turns all-ascending runs into the alternately
+    directed form the bitonic merge levels expect.  One fused XLA select."""
+    odd = (jnp.arange(arr.shape[0]) & 1)[:, None] == 1
+    return jnp.where(odd, arr[:, ::-1], arr)
+
+
+def _pad_runs(runs: jax.Array, pad_value) -> tuple[jax.Array, int]:
+    """Pad (R, L) runs to power-of-two rows/columns and >= 8*LANES total.
+
+    Column pads append ``pad_value`` to each row's tail (rows stay sorted:
+    the pad is the dtype's max); row pads append all-``pad_value`` runs.
+    Returns the padded array and the padded run length.
+    """
+    r, l = runs.shape
+    l2 = _ceil_pow2(l)
+    if l2 != l:
+        runs = jnp.concatenate(
+            [runs, jnp.full((r, l2 - l), pad_value, runs.dtype)], axis=1
+        )
+    r2 = _ceil_pow2(r)
+    while r2 * l2 < 8 * LANES:
+        r2 *= 2
+    if r2 != r:
+        runs = jnp.concatenate(
+            [runs, jnp.full((r2 - r, l2), pad_value, runs.dtype)]
+        )
+    return runs, l2
+
+
+def block_merge_runs(
+    runs: jax.Array,
+    block_rows: int = BLOCK_ROWS,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Merge R pre-sorted ascending rows ``(R, L)`` into one sorted array.
+
+    The post-shuffle combine the distributed sort actually needs (VERDICT r3
+    #2): each received row is already a sorted run, so only the top
+    ``~log2(R)`` merge levels of the bitonic network run — the full
+    re-sort's K1 tile sort (153 stages) is skipped entirely.  Sentinel pads
+    in the rows' tails ride along and sort to the back; the result has
+    length ``R * L`` exactly like the re-sort path.  Integer key dtypes
+    only (float callers pre-map via ``ops.float_order``), matching
+    `block_sort`'s dtype contract.
+    """
+    if runs.ndim != 2:
+        raise ValueError(f"block_merge_runs takes (R, L) runs, got {runs.shape}")
+    r, l = runs.shape
+    n = r * l
+    dtype = jnp.dtype(runs.dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        raise ValueError(
+            "block_merge_runs takes integer keys; map floats through "
+            "ops.float_order first (the framework pipelines already do)"
+        )
+    if r == 1 or n <= 1:
+        return runs.reshape(-1)
+    if interpret is None:
+        interpret = not _on_tpu()
+    sent = sentinel_for(dtype)
+
+    if dtype.itemsize == 8:
+        from dsort_tpu.ops.radix import _from_ordered_unsigned, _to_ordered_unsigned
+
+        u = _to_ordered_unsigned(runs.reshape(-1)).reshape(runs.shape)
+        # In the order-preserving unsigned space the dtype sentinel (max) is
+        # simply the all-ones word.
+        u, l2 = _pad_runs(u, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        u = _flip_odd_rows(u)
+        p = u.shape[0] * l2
+        hi = (u.reshape(-1) >> 32).astype(jnp.uint32).reshape(-1, LANES)
+        lo = u.reshape(-1).astype(jnp.uint32).reshape(-1, LANES)
+        hi, lo = _merge_planes((hi, lo), p, l2, block_rows, interpret)
+        out = (hi.reshape(-1).astype(jnp.uint64) << 32) | lo.reshape(-1).astype(
+            jnp.uint64
+        )
+        return _from_ordered_unsigned(out, dtype)[:n]
+
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        # Same sign-bit-flip bijection as block_sort (Mosaic has no
+        # unsigned vector min/max); rows stay sorted under the mapped order.
+        top = dtype.type(1 << (dtype.itemsize * 8 - 1))
+        signed = jnp.dtype(f"int{dtype.itemsize * 8}")
+        s = jax.lax.bitcast_convert_type(runs ^ top, signed)
+        s, l2 = _pad_runs(s, jnp.iinfo(signed).max)
+        s = _flip_odd_rows(s)
+        p = s.shape[0] * l2
+        (out,) = _merge_planes(
+            (s.reshape(-1, LANES),), p, l2, block_rows, interpret
+        )
+        return jax.lax.bitcast_convert_type(out.reshape(-1)[:n], dtype) ^ top
+
+    x, l2 = _pad_runs(runs, sent)
+    x = _flip_odd_rows(x)
+    p = x.shape[0] * l2
+    (out,) = _merge_planes((x.reshape(-1, LANES),), p, l2, block_rows, interpret)
+    return out.reshape(-1)[:n]
+
+
+def block_merge_runs_kv(
+    keys: jax.Array,
+    rank: jax.Array,
+    block_rows: int = BLOCK_ROWS,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Lexicographic ``(key, rank)`` merge of pre-sorted rows; both returned.
+
+    The kv combine counterpart of `block_merge_runs`: ``keys``/``rank`` are
+    ``(R, L)`` with each row sorted ascending by ``(key, rank)`` (the
+    shuffle's received rows with their ``is_pad * total + position``
+    tiebreak).  The rank plane rides the same merge network and comes back
+    as the payload gather permutation, exactly like `block_sort_pairs`.
+    """
+    if keys.shape != rank.shape or keys.ndim != 2:
+        raise ValueError(
+            f"block_merge_runs_kv takes equal (R, L) arrays, got "
+            f"{keys.shape} and {rank.shape}"
+        )
+    dtype = jnp.dtype(keys.dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        raise ValueError(
+            "block_merge_runs_kv takes integer keys; map floats through "
+            "ops.float_order first"
+        )
+    r, l = keys.shape
+    n = r * l
+    if r == 1 or n <= 1:
+        return keys.reshape(-1), rank.reshape(-1).astype(jnp.int32)
+    if interpret is None:
+        interpret = not _on_tpu()
+    sent = sentinel_for(dtype)
+    # Pad ranks ABOVE every real tiebreak (real values are < 2*n) with
+    # ascending values so padded tails/rows stay (key, rank)-sorted.
+    rank = rank.astype(jnp.int32)
+    l2 = _ceil_pow2(l)
+    if l2 != l:
+        col_pad = 2 * n + jnp.broadcast_to(
+            jnp.arange(l2 - l, dtype=jnp.int32), (r, l2 - l)
+        )
+        keys = jnp.concatenate(
+            [keys, jnp.full((r, l2 - l), sent, keys.dtype)], axis=1
+        )
+        rank = jnp.concatenate([rank, col_pad], axis=1)
+    r2 = _ceil_pow2(r)
+    while r2 * l2 < 8 * LANES:
+        r2 *= 2
+    if r2 != r:
+        row_pad = 3 * n + jnp.broadcast_to(
+            jnp.arange(l2, dtype=jnp.int32), (r2 - r, l2)
+        )
+        keys = jnp.concatenate([keys, jnp.full((r2 - r, l2), sent, keys.dtype)])
+        rank = jnp.concatenate([rank, row_pad])
+    keys = _flip_odd_rows(keys)
+    rank = _flip_odd_rows(rank)
+    p = r2 * l2
+    rp = rank.reshape(-1, LANES)
+    if dtype.itemsize == 8:
+        from dsort_tpu.ops.radix import _from_ordered_unsigned, _to_ordered_unsigned
+
+        u = _to_ordered_unsigned(keys.reshape(-1))
+        hi = (u >> 32).astype(jnp.uint32).reshape(-1, LANES)
+        lo = u.astype(jnp.uint32).reshape(-1, LANES)
+        hi, lo, rk = _merge_planes((hi, lo, rp), p, l2, block_rows, interpret)
+        u = (hi.reshape(-1).astype(jnp.uint64) << 32) | lo.reshape(-1).astype(
+            jnp.uint64
+        )
+        return _from_ordered_unsigned(u, dtype)[:n], rk.reshape(-1)[:n]
+    k, rk = _merge_planes(
+        (keys.reshape(-1, LANES), rp), p, l2, block_rows, interpret
+    )
+    return k.reshape(-1)[:n], rk.reshape(-1)[:n]
 
 
 def block_sort(

@@ -177,3 +177,94 @@ def test_block_sort_pairs_sentinel_keys_with_rank():
     )
     np.testing.assert_array_equal(np.asarray(ok), k)
     np.testing.assert_array_equal(np.asarray(orr), np.arange(n, dtype=np.int32))
+
+
+def _sorted_runs(rng, r, l, dtype=np.int32, pad_tail=0):
+    """r rows of l keys each, row-sorted, optionally sentinel-padded tails."""
+    lo, hi = (0, 2**32) if dtype == np.uint32 else (-(2**31), 2**31 - 1)
+    runs = np.sort(rng.integers(lo, hi, (r, l)).astype(dtype), axis=1)
+    if pad_tail:
+        sent = np.iinfo(dtype).max
+        for i in range(r):
+            k = int(rng.integers(0, pad_tail + 1))
+            if k:
+                runs[i, -k:] = sent
+                runs[i] = np.sort(runs[i])
+    return runs
+
+
+@pytest.mark.parametrize("r,l", [(2, 64), (4, 1000), (8, 4096), (3, 700),
+                                  (16, 256), (7, 128)])
+def test_block_merge_runs_matches_sort(r, l):
+    from dsort_tpu.ops.block_sort import block_merge_runs
+
+    rng = np.random.default_rng(r * 1000 + l)
+    runs = _sorted_runs(rng, r, l, pad_tail=l // 4)
+    out = np.asarray(
+        block_merge_runs(jnp.asarray(runs), block_rows=64, interpret=True)
+    )
+    np.testing.assert_array_equal(out, np.sort(runs.reshape(-1)))
+
+
+@pytest.mark.parametrize("dtype", [np.uint32, np.int64, np.uint64])
+def test_block_merge_runs_dtypes(dtype):
+    from dsort_tpu.ops.block_sort import block_merge_runs
+
+    rng = np.random.default_rng(17)
+    if np.dtype(dtype).itemsize == 8:
+        lo, hi = (
+            (0, 2**64) if dtype == np.uint64 else (-(2**63), 2**63 - 1)
+        )
+        runs = np.sort(
+            rng.integers(lo, hi, (8, 512), dtype=dtype), axis=1
+        )
+    else:
+        runs = _sorted_runs(rng, 8, 512, dtype=dtype)
+    out = np.asarray(
+        block_merge_runs(jnp.asarray(runs), block_rows=64, interpret=True)
+    )
+    np.testing.assert_array_equal(out, np.sort(runs.reshape(-1)))
+
+
+def test_block_merge_runs_spmd_shape_runs_exceed_block():
+    """Runs longer than a merge block take the cross/span-tail entry path
+    (the real SPMD shape: each received row spans >= 1 block)."""
+    from dsort_tpu.ops.block_sort import block_merge_runs
+
+    rng = np.random.default_rng(23)
+    runs = _sorted_runs(rng, 8, 64 * 128 * 2)  # 2 blocks per run at rows=64
+    out = np.asarray(
+        block_merge_runs(jnp.asarray(runs), block_rows=64, interpret=True)
+    )
+    np.testing.assert_array_equal(out, np.sort(runs.reshape(-1)))
+
+
+def test_block_merge_runs_kv_matches_lexsort():
+    from dsort_tpu.ops.block_sort import block_merge_runs_kv
+
+    rng = np.random.default_rng(29)
+    r, l = 8, 1024
+    total = r * l
+    # few distinct keys -> heavy ties; rank = is_pad*total + position per the
+    # shuffle's tiebreak, rows sorted by (key, rank)
+    keys = rng.integers(0, 50, (r, l)).astype(np.int32)
+    rank = np.arange(total, dtype=np.int32).reshape(r, l)
+    order = np.lexsort((rank, keys), axis=1)
+    keys = np.take_along_axis(keys, order, axis=1)
+    rank = np.take_along_axis(rank, order, axis=1)
+    out_k, out_r = block_merge_runs_kv(
+        jnp.asarray(keys), jnp.asarray(rank), block_rows=64, interpret=True
+    )
+    flat = np.lexsort((rank.reshape(-1), keys.reshape(-1)))
+    np.testing.assert_array_equal(np.asarray(out_k), keys.reshape(-1)[flat])
+    np.testing.assert_array_equal(np.asarray(out_r), rank.reshape(-1)[flat])
+
+
+def test_block_merge_runs_single_run():
+    from dsort_tpu.ops.block_sort import block_merge_runs
+
+    x = np.sort(np.random.default_rng(1).integers(0, 100, (1, 777)).astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(block_merge_runs(jnp.asarray(x), interpret=True)),
+        x.reshape(-1),
+    )
