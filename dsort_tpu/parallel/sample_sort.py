@@ -605,7 +605,53 @@ class BatchSampleSort:
             )
         )
 
-    def sort(self, jobs, metrics: Metrics | None = None):
+    def _bucket_cap(self, n: int) -> int:
+        per_shard = max(-(-n // self.num_workers), 1)
+        cap = 8
+        while cap < per_shard:
+            cap *= 2
+        return cap
+
+    def _job_ckpt(
+        self, job_id: str | None, data: np.ndarray,
+        payload: np.ndarray | None = None,
+    ):
+        """Per-job result checkpoint (shard 0 = sorted keys, 1 = payload).
+
+        Brings ``dsort batch`` into the recovery story (VERDICT r3 #7): a
+        killed batch re-run restores completed jobs and re-packs the
+        buckets over the missing ones.  The fingerprint covers the payload
+        too, so editing a record's payload (keys unchanged) re-sorts
+        instead of silently restoring the stale permutation.  Returns None
+        unless checkpointing is configured for this job.
+        """
+        if not (self.job.checkpoint_dir and job_id):
+            return None
+        from dsort_tpu.checkpoint import ShardCheckpoint
+        from dsort_tpu.models.external_sort import _fingerprint
+
+        ckpt = ShardCheckpoint(self.job.checkpoint_dir, job_id)
+        fp = _fingerprint(data)
+        if payload is not None:
+            fp += "|" + _fingerprint(payload)
+        shards = 1 if payload is None else 2
+        if ckpt.sync_manifest(shards, data.dtype, len(data), fp):
+            log.warning(
+                "batch job %r: checkpointed result belongs to different "
+                "data; cleared", job_id,
+            )
+        return ckpt
+
+    @staticmethod
+    def _check_unique_ids(job_ids) -> None:
+        ids = [j for j in job_ids if j]
+        dupes = sorted({j for j in ids if ids.count(j) > 1})
+        if dupes:
+            # Two jobs sharing a checkpoint id would fingerprint-clear each
+            # other every run — resume would silently never work.
+            raise ValueError(f"duplicate job_ids in batch: {dupes}")
+
+    def sort(self, jobs, metrics: Metrics | None = None, job_ids=None):
         """Sort a list of host key arrays; returns the sorted list.
 
         Jobs are grouped into **size buckets** (per-shard capacity rounded up
@@ -615,6 +661,13 @@ class BatchSampleSort:
         counter ``padded_elems`` records what was actually allocated).
         Power-of-two rounding bounds the number of distinct compiled
         programs at log2(largest/smallest).
+
+        ``job_ids`` (optional, parallel to ``jobs``) + ``JobConfig.
+        checkpoint_dir`` make the batch resumable: each completed job's
+        sorted result persists under its id, a re-run restores those
+        without re-sorting (counter ``batch_jobs_restored``), and the
+        buckets re-pack over only the missing jobs.  The fingerprint guard
+        clears a job's stale result if its data changed.
         """
         metrics = metrics if metrics is not None else Metrics()
         jobs = [np.asarray(j) for j in jobs]
@@ -630,53 +683,168 @@ class BatchSampleSort:
         if is_float_key_dtype(jobs[0].dtype):
             from dsort_tpu.ops.float_order import sort_float_key_batch_via_uint
 
-            return sort_float_key_batch_via_uint(self.sort, jobs, metrics)
-        p = self.num_workers
-
-        def bucket_cap(n: int) -> int:
-            per_shard = max(-(-n // p), 1)
-            cap = 8
-            while cap < per_shard:
-                cap *= 2
-            return cap
-
+            # Float keys pre-map to ordered uints; checkpoint under the
+            # MAPPED dtype (ids pass through so resume still works).
+            return sort_float_key_batch_via_uint(
+                self.sort, jobs, metrics, job_ids=job_ids
+            )
+        if job_ids is None:
+            job_ids = [None] * len(jobs)
+        self._check_unique_ids(job_ids)
+        outs: list = [None] * len(jobs)
+        ckpts: list = [None] * len(jobs)
+        for i, (j, jid) in enumerate(zip(jobs, job_ids)):
+            ckpts[i] = self._job_ckpt(jid, j)
+            if ckpts[i] is not None and ckpts[i].has(0):
+                outs[i] = ckpts[i].load(0)
+                metrics.bump("batch_jobs_restored")
         buckets: dict[int, list[int]] = {}
         for i, j in enumerate(jobs):
-            buckets.setdefault(bucket_cap(len(j)), []).append(i)
-        outs: list = [None] * len(jobs)
+            if outs[i] is None:
+                buckets.setdefault(self._bucket_cap(len(j)), []).append(i)
         for cap in sorted(buckets):
             idxs = buckets[cap]
-            for i, out in zip(idxs, self._sort_bucket(
-                [jobs[i] for i in idxs], cap, metrics
+            for i, out in zip(idxs, self._run_bucket(
+                [jobs[i] for i in idxs], None, cap, metrics
             )):
                 outs[i] = out
+                if ckpts[i] is not None:
+                    ckpts[i].save(0, out)
         return outs
 
-    def _sort_bucket(self, jobs, cap: int, metrics: Metrics):
-        """Sort one uniform-capacity batch (every job fits (w, cap))."""
+    @functools.lru_cache(maxsize=32)
+    def _build_kv(self, n_local: int, cap_pair: int, kv_trailing: tuple):
+        p = self.num_workers
+        shard_fn = functools.partial(
+            _sample_sort_kv_shard,
+            num_workers=p,
+            oversample=self.job.oversample,
+            cap_pair=cap_pair,
+            axis=self.axis,
+            kernel=self.job.local_kernel,
+            merge_kernel=self.job.merge_kernel,
+        )
+
+        def step(ks_b, vs_b, cs_b):
+            # Per-device block: (jobs_per_dp, n_local) keys, counts, and
+            # (jobs_per_dp, n_local, ...) payloads.
+            return jax.vmap(shard_fn)(ks_b, vs_b, cs_b)
+
+        return jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(self.dp_axis, self.axis),) * 3,
+                out_specs=(P(self.dp_axis, self.axis),) * 5,
+                check_vma=False,
+            )
+        )
+
+    def sort_kv(self, pairs, metrics: Metrics | None = None, job_ids=None):
+        """Batched key+payload sorts: ``pairs`` is a list of (keys, payload).
+
+        The kv counterpart of `sort` (VERDICT r3 #7): every job's payload
+        follows its keys through one batched shuffle program per (size,
+        payload-shape) bucket.  With ``job_ids`` + ``checkpoint_dir`` a
+        re-run restores completed jobs (keys as shard 0, payload as shard
+        1) without re-sorting.  Returns the list of (sorted_keys,
+        permuted_payload).  Integer keys only — float-keyed records go
+        through the single-job `SampleSort.sort_kv` (the ordered-uint
+        mapping there covers the kv path).
+        """
+        metrics = metrics if metrics is not None else Metrics()
+        pairs = [(np.asarray(k), np.asarray(v)) for k, v in pairs]
+        if not pairs:
+            return []
+        if any(k.dtype != pairs[0][0].dtype for k, _ in pairs):
+            raise TypeError(
+                f"all jobs must share one key dtype, got "
+                f"{sorted({str(k.dtype) for k, _ in pairs})}"
+            )
+        if is_float_key_dtype(pairs[0][0].dtype):
+            raise TypeError(
+                "batched kv sorts take integer keys; map floats through "
+                "ops.float_order (or use SampleSort.sort_kv per job)"
+            )
+        if job_ids is None:
+            job_ids = [None] * len(pairs)
+        self._check_unique_ids(job_ids)
+        outs: list = [None] * len(pairs)
+        ckpts: list = [None] * len(pairs)
+        for i, ((k, v), jid) in enumerate(zip(pairs, job_ids)):
+            if len(k) != len(v):
+                raise ValueError(
+                    f"job {i}: {len(k)} keys vs {len(v)} payload rows"
+                )
+            ckpts[i] = self._job_ckpt(jid, k, payload=v)
+            if ckpts[i] is not None and ckpts[i].has(0) and ckpts[i].has(1):
+                outs[i] = (ckpts[i].load(0), ckpts[i].load(1))
+                metrics.bump("batch_jobs_restored")
+        buckets: dict[tuple, list[int]] = {}
+        for i, (k, v) in enumerate(pairs):
+            if outs[i] is None:
+                key = (self._bucket_cap(len(k)), v.shape[1:], v.dtype.str)
+                buckets.setdefault(key, []).append(i)
+        for bkey in sorted(buckets, key=str):
+            idxs = buckets[bkey]
+            for i, out in zip(idxs, self._run_bucket(
+                [pairs[i][0] for i in idxs], [pairs[i][1] for i in idxs],
+                bkey[0], metrics,
+            )):
+                outs[i] = out
+                if ckpts[i] is not None:
+                    ckpts[i].save(0, out[0])
+                    ckpts[i].save(1, out[1])
+        return outs
+
+    def _run_bucket(self, keys_list, payloads_list, cap: int, metrics: Metrics):
+        """Sort ONE uniform-capacity batch (every job fits ``(w, cap)``).
+
+        The single bucket driver for both the key-only and kv paths
+        (``payloads_list=None`` selects key-only): one copy of the padding
+        layout, the measured-capacity retry loop, and the per-worker
+        assemble.  Returns sorted key arrays, or (keys, payload) tuples.
+        """
+        kv = payloads_list is not None
         timer = PhaseTimer(metrics)
         p, dp = self.num_workers, self.dp
         # Pad the batch to a multiple of dp jobs (empty filler jobs), and
         # every job to ONE shared (w, cap) layout so the program is static.
-        n_jobs = len(jobs)
+        n_jobs = len(keys_list)
         batch = -(-n_jobs // dp) * dp
+        trailing = payloads_list[0].shape[1:] if kv else ()
         metrics.bump("padded_elems", batch * p * cap)
         with timer.phase("partition"):
-            ks = np.empty((batch, p * cap), dtype=jobs[0].dtype)
+            ks = np.empty((batch, p * cap), dtype=keys_list[0].dtype)
             cs = np.zeros((batch, p), dtype=np.int32)
+            if kv:
+                vs = np.zeros(
+                    (batch, p * cap) + trailing, dtype=payloads_list[0].dtype
+                )
             for b in range(batch):
-                data = jobs[b] if b < n_jobs else jobs[0][:0]
-                shards, counts = pad_to_shards(data, p, cap=cap)
-                ks[b] = shards.reshape(-1)
+                k = keys_list[b] if b < n_jobs else keys_list[0][:0]
+                if kv:
+                    v = payloads_list[b] if b < n_jobs else payloads_list[0][:0]
+                    sk, sv, counts = pad_kv_to_shards(k, v, p, cap=cap)
+                    vs[b] = sv.reshape((-1,) + trailing)
+                else:
+                    sk, counts = pad_to_shards(k, p, cap=cap)
+                ks[b] = sk.reshape(-1)
                 cs[b] = counts
             sharding = NamedSharding(self.mesh, P(self.dp_axis, self.axis))
-            xs = jax.device_put(jnp.asarray(ks), sharding)
+            xj = jax.device_put(jnp.asarray(ks), sharding)
             cj = jax.device_put(jnp.asarray(cs), sharding)
+            if kv:
+                vj = jax.device_put(jnp.asarray(vs), sharding)
         cap_pair = cap_pair_policy(cap, self.job.capacity_factor, p)
         for _ in range(self.job.max_capacity_retries + 1):
-            fn = self._build(cap, cap_pair)
             with timer.phase("spmd_sort"):
-                merged, out_counts, overflow, max_len = fn(xs, cj)
+                if kv:
+                    fn = self._build_kv(cap, cap_pair, trailing)
+                    out_k, out_v, out_counts, overflow, max_len = fn(xj, vj, cj)
+                else:
+                    fn = self._build(cap, cap_pair)
+                    out_k, out_counts, overflow, max_len = fn(xj, cj)
                 # One fetch = completion barrier + every retry scalar (see
                 # sort_ranges).
                 c, ov, ml = jax.device_get((out_counts, overflow, max_len))
@@ -690,10 +858,19 @@ class BatchSampleSort:
         else:
             raise RuntimeError("sample sort bucket overflow after max retries")
         with timer.phase("assemble"):
-            m = np.asarray(merged).reshape(batch, p, -1)
+            mk = np.asarray(out_k).reshape(batch, p, -1)
             c = c.reshape(batch, p)
-            outs = [
-                np.concatenate([m[b, i, : c[b, i]] for i in range(p)])
+            keys_out = [
+                np.concatenate([mk[b, i, : c[b, i]] for i in range(p)])
                 for b in range(n_jobs)
             ]
-        return outs
+            if not kv:
+                return keys_out
+            mv = np.asarray(out_v).reshape((batch, p, mk.shape[2]) + trailing)
+            return [
+                (
+                    keys_out[b],
+                    np.concatenate([mv[b, i, : c[b, i]] for i in range(p)]),
+                )
+                for b in range(n_jobs)
+            ]

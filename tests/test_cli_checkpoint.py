@@ -336,3 +336,43 @@ def test_cli_batch_overcommit_clean_error(tmp_path):
             "batch", str(src), "--outdir", str(tmp_path / "o"),
             "--dp", "2", "--workers", "8",
         ])
+
+
+def test_cli_batch_checkpoint_resume(tmp_path):
+    """`dsort batch --checkpoint-dir`: a re-run restores every completed
+    file from its checkpoint instead of re-sorting (VERDICT r3 #7)."""
+    import dsort_tpu.parallel.sample_sort as ssmod
+
+    ins = []
+    datas = []
+    for i, n in enumerate((4_000, 1_000, 7_000)):
+        p = tmp_path / f"b{i}.txt"
+        d = gen_uniform(n, seed=40 + i)
+        write_ints_file(p, d)
+        ins.append(str(p))
+        datas.append(d)
+    outdir, ck = str(tmp_path / "out"), str(tmp_path / "ck")
+    rc = cli_main(["batch", *ins, "--outdir", outdir, "--checkpoint-dir", ck])
+    assert rc == 0
+    for i, d in enumerate(datas):
+        np.testing.assert_array_equal(
+            read_ints_file(os.path.join(outdir, f"b{i}.txt")), np.sort(d)
+        )
+    # Second run: every job restores; no bucket program executes.
+    calls = []
+    orig = ssmod.BatchSampleSort._run_bucket
+    ssmod.BatchSampleSort._run_bucket = (
+        lambda self, ks, vs, cap, m: calls.append(cap) or orig(self, ks, vs, cap, m)
+    )
+    try:
+        rc = cli_main(
+            ["batch", *ins, "--outdir", outdir, "--checkpoint-dir", ck]
+        )
+    finally:
+        ssmod.BatchSampleSort._run_bucket = orig
+    assert rc == 0
+    assert calls == []
+    for i, d in enumerate(datas):
+        np.testing.assert_array_equal(
+            read_ints_file(os.path.join(outdir, f"b{i}.txt")), np.sort(d)
+        )

@@ -317,6 +317,113 @@ def test_batch_size_bucketing_padded_volume(devices):
 # ---- VERDICT r2 item 1: P=1 short-circuit, measured capacity, kernel merge ----
 
 
+def test_batch_checkpoint_restores_completed_jobs(devices, tmp_path):
+    """VERDICT r3 #7: a re-run of `BatchSampleSort.sort` with job_ids
+    restores completed jobs from disk and re-packs the buckets over only
+    the missing/stale ones."""
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    job = JobConfig(checkpoint_dir=str(tmp_path))
+    rng = np.random.default_rng(71)
+    jobs = [
+        rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32)
+        for n in (5_000, 12_000, 900, 7_000, 3_000)
+    ]
+    ids = [f"file{i}" for i in range(len(jobs))]
+    bss = BatchSampleSort(mesh, job)
+    m1 = Metrics()
+    outs1 = bss.sort(jobs, metrics=m1, job_ids=ids)
+    for j, o in zip(jobs, outs1):
+        np.testing.assert_array_equal(o, np.sort(j))
+    assert "batch_jobs_restored" not in m1.counters
+
+    # Re-run (the "killed and restarted" case, all jobs complete): every
+    # job restores, no bucket is sorted at all.
+    bss2 = BatchSampleSort(mesh, job)
+    calls = []
+    orig = bss2._run_bucket
+    bss2._run_bucket = lambda ks, vs, cap, m: calls.append(cap) or orig(ks, vs, cap, m)
+    m2 = Metrics()
+    outs2 = bss2.sort(jobs, metrics=m2, job_ids=ids)
+    for a, b in zip(outs1, outs2):
+        np.testing.assert_array_equal(a, b)
+    assert m2.counters["batch_jobs_restored"] == len(jobs)
+    assert calls == []
+
+    # One file's data changes: only that job re-sorts (fingerprint guard).
+    jobs[2] = rng.integers(-(2**31), 2**31 - 1, 900).astype(np.int32)
+    m3 = Metrics()
+    outs3 = BatchSampleSort(mesh, job).sort(jobs, metrics=m3, job_ids=ids)
+    np.testing.assert_array_equal(outs3[2], np.sort(jobs[2]))
+    assert m3.counters["batch_jobs_restored"] == len(jobs) - 1
+
+
+def test_batch_kv_many_jobs(devices):
+    """Batched key+payload sorts: payloads follow their keys per job."""
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    rng = np.random.default_rng(73)
+    pairs = []
+    for n in (4_000, 1_500, 9_000, 2_500):
+        keys = rng.integers(-1000, 1000, n).astype(np.int32)
+        payload = rng.integers(0, 255, (n, 3)).astype(np.uint8)
+        pairs.append((keys, payload))
+    outs = BatchSampleSort(mesh).sort_kv(pairs)
+    for (k, v), (sk, sv) in zip(pairs, outs):
+        np.testing.assert_array_equal(sk, np.sort(k))
+        assert sorted(zip(sk.tolist(), map(bytes, sv))) == sorted(
+            zip(k.tolist(), map(bytes, v))
+        )
+
+
+def test_batch_kv_checkpoint_resume(devices, tmp_path):
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    job = JobConfig(checkpoint_dir=str(tmp_path))
+    rng = np.random.default_rng(75)
+    pairs = [
+        (
+            rng.integers(0, 10_000, n).astype(np.int32),
+            rng.integers(0, 255, (n, 4)).astype(np.uint8),
+        )
+        for n in (3_000, 6_000, 1_200)
+    ]
+    ids = [f"kv{i}" for i in range(len(pairs))]
+    outs1 = BatchSampleSort(mesh, job).sort_kv(pairs, job_ids=ids)
+    m2 = Metrics()
+    outs2 = BatchSampleSort(mesh, job).sort_kv(pairs, metrics=m2, job_ids=ids)
+    assert m2.counters["batch_jobs_restored"] == len(pairs)
+    for (k1, v1), (k2, v2) in zip(outs1, outs2):
+        np.testing.assert_array_equal(k1, k2)
+        np.testing.assert_array_equal(v1, v2)
+
+
+def test_batch_kv_mixed_payload_shapes_bucketed(devices):
+    """Jobs with different payload widths land in different buckets but one
+    call sorts them all."""
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    rng = np.random.default_rng(77)
+    pairs = [
+        (
+            rng.integers(0, 100, 2_000).astype(np.int32),
+            rng.integers(0, 255, (2_000, w)).astype(np.uint8),
+        )
+        for w in (2, 5, 2)
+    ]
+    outs = BatchSampleSort(mesh).sort_kv(pairs)
+    for (k, v), (sk, sv) in zip(pairs, outs):
+        np.testing.assert_array_equal(sk, np.sort(k))
+        assert sv.shape == v.shape
+        assert sorted(zip(sk.tolist(), map(bytes, sv))) == sorted(
+            zip(k.tolist(), map(bytes, v))
+        )
+
+
 def test_p1_sorts_exactly_once():
     """On a single-device mesh the SPMD path must invoke exactly ONE local
     sort — no splitters, no all_to_all, no second (merge) sort."""

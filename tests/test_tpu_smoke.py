@@ -162,3 +162,50 @@ def test_taskpool_block_kernel_on_chip():
     sched = Scheduler(DeviceExecutor())
     out = sched.run_job(data)
     np.testing.assert_array_equal(out, np.sort(data))
+
+
+@on_tpu
+def test_block_merge_runs_on_chip():
+    """Hardware gate for the merge-entry kernels (r4): the span_low kb_start
+    parametrization and the odd-row flip must legalize under Mosaic, not
+    just under the interpreter."""
+    from dsort_tpu.ops.block_sort import block_merge_runs
+
+    rng = np.random.default_rng(41)
+    # The SPMD post-shuffle shape: 8 runs of one merge block each.
+    runs = np.sort(
+        rng.integers(-(2**31), 2**31 - 1, (8, 1 << 17), dtype=np.int64)
+        .astype(np.int32),
+        axis=1,
+    )
+    out = np.asarray(block_merge_runs(jnp.asarray(runs), interpret=False))
+    np.testing.assert_array_equal(out, np.sort(runs.reshape(-1)))
+
+    # Runs smaller than a block: the _sort_levels(k_start) entry.
+    small = np.sort(
+        rng.integers(-(2**31), 2**31 - 1, (16, 1 << 13), dtype=np.int64)
+        .astype(np.int32),
+        axis=1,
+    )
+    out2 = np.asarray(block_merge_runs(jnp.asarray(small), interpret=False))
+    np.testing.assert_array_equal(out2, np.sort(small.reshape(-1)))
+
+
+@on_tpu
+def test_block_merge_runs_kv_on_chip():
+    from dsort_tpu.ops.block_sort import block_merge_runs_kv
+
+    rng = np.random.default_rng(43)
+    r, l = 8, 1 << 14
+    total = r * l
+    keys = rng.integers(0, 1000, (r, l)).astype(np.int32)  # heavy ties
+    rank = np.arange(total, dtype=np.int32).reshape(r, l)
+    order = np.lexsort((rank, keys), axis=1)
+    keys = np.take_along_axis(keys, order, axis=1)
+    rank = np.take_along_axis(rank, order, axis=1)
+    out_k, out_r = block_merge_runs_kv(
+        jnp.asarray(keys), jnp.asarray(rank), interpret=False
+    )
+    flat = np.lexsort((rank.reshape(-1), keys.reshape(-1)))
+    np.testing.assert_array_equal(np.asarray(out_k), keys.reshape(-1)[flat])
+    np.testing.assert_array_equal(np.asarray(out_r), rank.reshape(-1)[flat])
