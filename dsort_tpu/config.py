@@ -93,7 +93,10 @@ class JobConfig:
     key_dtype: Any = jnp.int32
     payload_bytes: int = 0          # 0 → key-only sort; >0 → TeraSort-style records
     local_kernel: str = "auto"      # per-chip sort: "auto" | "lax" | "block" | "bitonic" | "pallas" | "radix"
-    merge_kernel: str = "sort"      # post-shuffle combine: "sort" | "bitonic" | "block_merge"
+    # Post-shuffle combine: "auto" (block_merge wherever the block kernel
+    # applies — measured 6x the flat re-sort on chip) | "sort" | "bitonic"
+    # | "block_merge".
+    merge_kernel: str = "auto"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
     # Per-(src,dst) all_to_all bucket headroom over the ideal n/P split.
@@ -143,10 +146,10 @@ class JobConfig:
             raise ConfigError(
                 f"local_kernel must be one of {LOCAL_KERNELS}, got {self.local_kernel!r}"
             )
-        if self.merge_kernel not in ("sort", "bitonic", "block_merge"):
+        if self.merge_kernel not in ("auto", "sort", "bitonic", "block_merge"):
             raise ConfigError(
-                "merge_kernel must be 'sort', 'bitonic' or 'block_merge', "
-                f"got {self.merge_kernel!r}"
+                "merge_kernel must be 'auto', 'sort', 'bitonic' or "
+                f"'block_merge', got {self.merge_kernel!r}"
             )
         if self.oversample < 1:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")

@@ -131,23 +131,31 @@ def _exchange_rows(xs: tuple, j: int, asc, active=None) -> tuple:
     views = [x.reshape(rows // (2 * j), 2, j, LANES) for x in xs]
     a = tuple(v[:, 0] for v in views)
     b = tuple(v[:, 1] for v in views)
-    if len(xs) == 1:
+    if len(xs) == 1 and active is None:
         lo, hi = jnp.minimum(a[0], b[0]), jnp.maximum(a[0], b[0])
         out = jnp.stack(
             [jnp.where(asc, lo, hi), jnp.where(asc, hi, lo)], axis=1
         )
         outs = (out.reshape(rows, LANES),)
     else:
-        take_a = _lex_lt(a, b) == asc  # a first iff (a<b) matches direction
+        # Swap-mask formulation; `active` (runtime predication for stages
+        # whose block distance exceeds the level's) folds INTO the mask —
+        # a predicated-off stage costs one `&`, not a full extra select
+        # per plane (r4, VERDICT r3 #5).  Swapping equals under descending
+        # order is harmless (identical values).
+        if len(xs) == 1:
+            swap = (a[0] > b[0]) == asc
+        else:
+            swap = _lex_lt(a, b) != asc  # swap iff a does NOT belong first
+        if active is not None:
+            swap = swap & active
         outs = []
         for ap, bp in zip(a, b):
             out = jnp.stack(
-                [jnp.where(take_a, ap, bp), jnp.where(take_a, bp, ap)], axis=1
+                [jnp.where(swap, bp, ap), jnp.where(swap, ap, bp)], axis=1
             )
             outs.append(out.reshape(rows, LANES))
         outs = tuple(outs)
-    if active is not None:  # predicated no-op when this stage's m > level's
-        outs = tuple(jnp.where(active, o, x) for o, x in zip(outs, xs))
     return outs
 
 

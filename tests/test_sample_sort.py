@@ -203,6 +203,28 @@ def test_sample_sort_block_merge_on_7_device_mesh():
     np.testing.assert_array_equal(out, np.sort(data))
 
 
+def test_merge_kernel_auto_resolves_to_block_merge(mesh8, monkeypatch):
+    """The default ('auto') must route to block_merge wherever the block
+    kernel carries the sort — pinned with local_kernel='block', which
+    resolves to 'block' even off-TPU (interpret mode), since on CPU the
+    plain default silently takes the 'sort' branch."""
+    import dsort_tpu.ops.block_sort as bmod
+
+    calls = []
+    real = bmod.block_merge_runs
+
+    def spy(runs, *a, **kw):
+        calls.append(runs.shape)
+        return real(runs, *a, **kw)
+
+    monkeypatch.setattr(bmod, "block_merge_runs", spy)
+    data = gen_uniform(30_000, seed=65)
+    job = JobConfig(local_kernel="block", merge_kernel="auto")
+    out = SampleSort(mesh8, job).sort(data)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert calls, "auto never dispatched to block_merge_runs"
+
+
 def test_sample_sort_kv_block_merge_kernel(mesh8):
     from dsort_tpu.data.ingest import gen_terasort
 
