@@ -41,24 +41,35 @@ K2/K2b/K3 take the merge level as an SMEM scalar, so one compilation serves
 every level.  Total HBM passes for 2^24 at the defaults: 1 (K1) + 1 (K2a) +
 6 (K2) + 3 (K2b/K3) = 11, vs ~250 for ``lax.sort``.
 
-Measured pass costs at 2^24 int32 (v5e via tunnel, slope method, r3 —
-model sum matches the full-kernel slope within 3%):
+Measured pass costs at 2^24 int32 (v5e via tunnel, slope method; r4
+numbers normalized across probe sessions by the unchanged-K1 drift —
+tunnel state swings ~15% between sessions, so treat per-pass rows as
++-10%):
 
   ====================  ========  ======================================
   pass                  ms/pass   vs its own bound
   ====================  ========  ======================================
-  K1 tile sort          3.32      ~92% of VPU ops bound (~3.0 ms: 125
+  K1 tile sort          3.32-3.38 ~92% of VPU ops bound (~3.0 ms: 125
                                   row-stages x ~5 + 28 lane x ~13 ops)
-  K2 cross (any m)      0.19-.21  at DMA bound (2n bytes @ ~725 GB/s)
-  K2b/K3 span-tail      0.43-.90  kb=2 at its ~0.5 ms ops bound; high kb
-                                  runs above it (direction-mask overhead)
-  full kernel           9.14      sum-of-passes 8.91; ~85% VPU-bound
+  K2 cross (any m)      0.19-.21  at DMA bound (2n bytes @ ~725 GB/s, r3)
+  K2b/K3 span-tail      0.69-.76  FLAT across kb (r4; r3's kb-dependence
+                                  0.43->0.90 is gone — runtime
+                                  predication folds into the swap mask
+                                  and direction masks come per-stage
+                                  from tiny pair-shaped iotas instead of
+                                  slicing one big per-row mask).
+                                  Residual ~0.2 ms/pass above the
+                                  ~0.5 ms ops bound is the pair-view
+                                  reshape data movement.
+  K2a span_low          1.70-1.93 4 fused levels (~57 stages)
+  full kernel           7.9       same-session slope vs lax (r3: 8.6);
+                                  ~85% VPU-bound
   ====================  ========  ======================================
 
 The kernel is compute-bound on the VPU, not HBM-bound: total DMA is only
 ~11 x 0.17 ms.  Further gains must cut *stages* (hence K2a's fusion) or
 per-stage ops; the stage formulations below are already the cheapest of
-the measured alternatives.
+the measured alternatives (see also the MXU go/no-go below).
 
 Exchange formulations are chosen per distance from on-chip microbenchmarks:
 vreg-aligned row distances (j >= 8) use a pair view ``(pairs, 2, j, 128)``
@@ -76,6 +87,20 @@ split.  A design note for the judge: an MSD bucket/radix alternative was
 costed against this network and rejected — per-fragment dynamic DMA overhead
 (~ntiles x buckets copies) exceeds the ~20% stage saving, and XLA's
 scatter/gather path measures 115-148 Mkeys/s, far below this kernel.
+
+**MXU counting-sort go/no-go (r4, measured)**: could the MXU replace K1?
+On-chip: a one-hot bucket histogram (n=2^17, B=512, bf16 contraction) runs
+43.5 us/pass and a SHARED-P permutation-apply (1024x1024)@(1024x128) hits
+4.59 us/tile (58.5 TFLOP/s) — the MXU itself is plenty fast.  **No-go**
+anyway, on the two steps around it: (1) computing ranks for a real sort is
+pairwise-compare work the MXU cannot express (comparison is not a
+multiply-add) — 2^27 VPU compare-ops per tile ~= 24 us, already K1's whole
+26 us/tile budget; (2) a REAL sort needs a different permutation per
+column, and materializing per-column one-hot P matrices is an n^2/column
+tensor — (128, 1024, 1024) bf16 = 256 MB per tile, ~350 us of HBM traffic
+at the measured 725 GB/s, 13x K1's total — while scatter-free in-VMEM
+placement without P needs a cross-sublane vector gather Mosaic does not
+have.  The comparator network stays.
 
 Correctness is dtype-generic (int32/uint32/float32/int64/uint64 tested);
 floats follow min/max semantics, so NaN-carrying keys must go through the
@@ -223,9 +248,13 @@ def _keep_or_swap(xs: tuple, partners: tuple, am_first, asc) -> tuple:
 def _level_stages(xs, k, rows, lane, rowi, asc_top=None):
     """Run merge level ``k``'s stages (distances k/2 .. 1), row-major order.
 
-    ``asc_top``: direction override (traced scalar) for levels whose
-    direction bit lies above the block — None means the bit is local.
+    ``asc_top``: direction override for levels whose direction bit lies
+    above the block — a traced scalar, or a CALLABLE ``asc_top(j)``
+    returning the pair-shaped mask for a row-distance-``j`` exchange
+    (``j=None`` for the elementwise roll/lane form).  None means the bit
+    is local to the block.
     """
+    gen = callable(asc_top)
     d = k // 2
     while d >= 1:
         if d >= LANES:
@@ -234,7 +263,7 @@ def _level_stages(xs, k, rows, lane, rowi, asc_top=None):
                 if asc_top is None:
                     asc = (rowi & (k // LANES)) == 0
                 else:
-                    asc = asc_top
+                    asc = asc_top(None) if gen else asc_top
                 xs = _exchange_rows_roll(xs, j, asc)
             else:
                 if asc_top is None:
@@ -245,11 +274,11 @@ def _level_stages(xs, k, rows, lane, rowi, asc_top=None):
                     )
                     asc = ((m * (2 * j)) & (k // LANES)) == 0
                 else:
-                    asc = asc_top
+                    asc = asc_top(j) if gen else asc_top
                 xs = _exchange_rows(xs, j, asc)
         else:
             if asc_top is not None:
-                asc = asc_top
+                asc = asc_top(None) if gen else asc_top
             elif k <= LANES // 2:
                 asc = (lane & k) == 0
             else:  # k >= 128: the direction bit is a row bit
@@ -397,31 +426,60 @@ def _span_tail_kernel(k_ref, *refs, rows: int, m_hi: int, np_: int):
     span = 2 * m_hi
     xs = tuple(r[:] for r in refs[:np_])
     kb = k_ref[0, 0]
-    rowi_span = jax.lax.broadcasted_iota(jnp.int32, (span * rows, 1), 0)
-    blk = pl.program_id(0) * span + rowi_span // rows
-    asc_rows = (blk & kb) == 0  # (span*rows, 1), constant per block
     lane = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 1)
     rowi = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 0)
-    xs = _level_pass(xs, asc_rows, m_hi, rows, span * rows, lane, rowi,
+    asc_of = _span_asc_gen(pl.program_id(0) * span, kb, rows, span * rows)
+    xs = _level_pass(xs, asc_of, m_hi, rows, span * rows, lane, rowi,
                      active_for=lambda m: kb >= 2 * m)
     for o_ref, x in zip(refs[np_:], xs):
         o_ref[:] = x
 
 
-def _level_pass(xs, asc_rows, m_top: int, rows: int, span_rows: int,
+def _span_asc_gen(base_blk, kb, rows: int, span_rows: int):
+    """Direction-mask generator for span-resident passes.
+
+    ``asc(j)`` returns the mask for an exchange at row distance ``j``
+    directly in PAIR shape ``(npairs, 1, 1)`` from a tiny iota — instead of
+    reshaping/slicing one materialized ``(span_rows, 1)`` mask per stage
+    (measured r4: the slice-per-stage form ran the span-tail ~60% above its
+    ops bound).  ``j=None`` yields the elementwise per-row form for the
+    roll/lane paths.  Valid because every exchange pair sits inside one
+    block (sub-block stages) or spans blocks sharing the ``kb`` direction
+    bit (cross stages at distance m have kb >= 2m).
+    """
+    cache: dict = {}
+
+    def asc(j=None):
+        if j in cache:  # one jaxpr definition per distance per level
+            return cache[j]
+        if j is None:
+            rowi = jax.lax.broadcasted_iota(jnp.int32, (span_rows, 1), 0)
+            v = ((base_blk + rowi // rows) & kb) == 0
+        else:
+            npairs = span_rows // (2 * j)
+            m = jax.lax.broadcasted_iota(jnp.int32, (npairs, 1, 1), 0)
+            v = ((base_blk + (m * (2 * j)) // rows) & kb) == 0
+        cache[j] = v
+        return v
+
+    return asc
+
+
+def _level_pass(xs, asc_of, m_top: int, rows: int, span_rows: int,
                 lane, rowi, active_for=None):
     """One merge level's in-span stage sequence, shared by K2a and K2b/K3:
     cross stages at block distances ``m_top..2`` (optionally predicated via
     ``active_for(m)`` when the level arrives at runtime), the distance-one-
-    block stage, then every block's intra-block merge tail."""
+    block stage, then every block's intra-block merge tail.  ``asc_of`` is
+    a `_span_asc_gen`-style callable."""
     m = m_top
     while m >= 2:
         act = None if active_for is None else active_for(m)
-        xs = _exchange_rows(xs, m * rows, asc_rows, active=act)
+        xs = _exchange_rows(xs, m * rows, asc_of(m * rows), active=act)
         m //= 2
-    xs = _exchange_rows(xs, rows, asc_rows)
+    xs = _exchange_rows(xs, rows, asc_of(rows))
     return _level_stages(xs, rows * LANES, span_rows, lane, rowi,
-                         asc_top=asc_rows)
+                         asc_top=asc_of)
 
 
 def _span_low_kernel(*refs, rows: int, m_hi: int, np_: int, kb_start: int = 2):
@@ -444,14 +502,13 @@ def _span_low_kernel(*refs, rows: int, m_hi: int, np_: int, kb_start: int = 2):
 
     xs = tuple(r[:] for r in refs[:np_])
     span = 2 * m_hi
-    rowi_span = jax.lax.broadcasted_iota(jnp.int32, (span * rows, 1), 0)
-    blk = pl.program_id(0) * span + rowi_span // rows
     lane = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 1)
     rowi = jax.lax.broadcasted_iota(jnp.int32, (span * rows, LANES), 0)
+    base = pl.program_id(0) * span
     kb = kb_start
     while kb <= span:
-        asc_rows = (blk & kb) == 0  # per-block direction, constant per pair
-        xs = _level_pass(xs, asc_rows, kb // 2, rows, span * rows, lane, rowi)
+        asc_of = _span_asc_gen(base, kb, rows, span * rows)
+        xs = _level_pass(xs, asc_of, kb // 2, rows, span * rows, lane, rowi)
         kb *= 2
     for o_ref, x in zip(refs[np_:], xs):
         o_ref[:] = x
