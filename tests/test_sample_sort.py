@@ -423,6 +423,38 @@ def test_batch_kv_checkpoint_resume(devices, tmp_path):
         np.testing.assert_array_equal(v1, v2)
 
 
+def test_batch_float_jobs_checkpoint_resume(devices, tmp_path):
+    """Float batches checkpoint under the mapped ordered-uint dtype and
+    still restore correctly (NaNs included)."""
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    job = JobConfig(checkpoint_dir=str(tmp_path))
+    rng = np.random.default_rng(79)
+    jobs = []
+    for n in (2_000, 5_000):
+        a = (rng.standard_normal(n) * 1e6).astype(np.float32)
+        a[:: max(n // 7, 1)] = np.nan
+        jobs.append(a)
+    ids = ["fa", "fb"]
+    outs1 = BatchSampleSort(mesh, job).sort(jobs, job_ids=ids)
+    m2 = Metrics()
+    outs2 = BatchSampleSort(mesh, job).sort(jobs, metrics=m2, job_ids=ids)
+    assert m2.counters["batch_jobs_restored"] == 2
+    for j, o1, o2 in zip(jobs, outs1, outs2):
+        np.testing.assert_array_equal(o1, o2)
+        np.testing.assert_array_equal(o1, np.sort(j))  # NaNs last, np-style
+
+
+def test_batch_kv_rejects_float_keys(devices):
+    from dsort_tpu.parallel.sample_sort import BatchSampleSort
+
+    mesh = _mesh_dp2(devices)
+    pairs = [(np.zeros(8, np.float32), np.zeros((8, 2), np.uint8))]
+    with pytest.raises(TypeError, match="integer keys"):
+        BatchSampleSort(mesh).sort_kv(pairs)
+
+
 def test_batch_kv_mixed_payload_shapes_bucketed(devices):
     """Jobs with different payload widths land in different buckets but one
     call sorts them all."""
