@@ -51,6 +51,8 @@ def _load_config(args) -> SortConfig:
         job_over["key_dtype"] = np.dtype(args.dtype)
     if getattr(args, "kernel", None):
         job_over["local_kernel"] = args.kernel
+    if getattr(args, "merge_kernel", None):
+        job_over["merge_kernel"] = args.merge_kernel
     if getattr(args, "checkpoint_dir", None):
         job_over["checkpoint_dir"] = args.checkpoint_dir
     if job_over:
@@ -666,6 +668,10 @@ def main(argv=None) -> int:
         p.add_argument("--workers", type=int)
         p.add_argument("--dtype")
         p.add_argument("--kernel", choices=["auto", "lax", "block", "bitonic", "pallas", "radix"])
+        p.add_argument("--merge-kernel",
+                       choices=["auto", "sort", "bitonic", "block_merge"],
+                       help="post-shuffle combine (default auto: block_merge "
+                            "wherever the block kernel applies)")
         p.add_argument("--checkpoint-dir",
                        help="persist per-shard/range progress here; a re-run "
                             "of the same input resumes instead of re-sorting")

@@ -6,6 +6,7 @@ from dsort_tpu.scheduler.fault import (  # noqa: F401
     FaultInjector,
     JobFailedError,
     ProgramWaitTimeout,
+    WorkerWaitTimeout,
     WorkerFailure,
 )
 from dsort_tpu.scheduler.scheduler import (  # noqa: F401

@@ -32,6 +32,15 @@ class ProgramWaitTimeout(TimeoutError):
     """
 
 
+class WorkerWaitTimeout(TimeoutError):
+    """A per-shard attempt's heartbeat wait lapsed (taskpool hang detection).
+
+    The taskpool counterpart of `ProgramWaitTimeout`: only THIS type means
+    "the worker hung" and triggers reassignment; a genuine ``TimeoutError``
+    raised inside the attempt surfaces through the ordinary error path.
+    """
+
+
 class AttemptCancelled(RuntimeError):
     """Raised inside an abandoned attempt at its next cancellation check.
 

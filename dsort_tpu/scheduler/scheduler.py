@@ -45,6 +45,7 @@ from dsort_tpu.scheduler.fault import (
     JobFailedError,
     ProgramWaitTimeout,
     WorkerFailure,
+    WorkerWaitTimeout,
     classify_runtime_error,
 )
 from dsort_tpu.scheduler.liveness import WorkerTable
@@ -236,11 +237,11 @@ class Scheduler:
         key = self._warm_key(worker, shard)
         if not done.wait(timeout=self._timeout_for(key)):
             abandoned.set()  # if still queued, it will be skipped, not run
-            raise TimeoutError(f"worker {worker} heartbeat timeout")
+            raise WorkerWaitTimeout(f"worker {worker} heartbeat timeout")
         if "e" in box:
             raise box["e"]
         if "r" not in box:  # skipped as abandoned by a racing earlier waiter
-            raise TimeoutError(f"worker {worker} attempt abandoned")
+            raise WorkerWaitTimeout(f"worker {worker} attempt abandoned")
         self._warm_shapes.add(key)
         return box["r"]
 
@@ -274,7 +275,10 @@ class Scheduler:
                 return  # result pinned to slot i (server.c:415)
             except Exception as e:
                 kind = classify_runtime_error(e)
-                if isinstance(e, (WorkerFailure, TimeoutError)):
+                # Only the dedicated wait-timeout type means "worker hung";
+                # a genuine TimeoutError from inside the attempt surfaces
+                # through the ordinary error path below.
+                if isinstance(e, (WorkerFailure, WorkerWaitTimeout)):
                     stage = getattr(e, "stage", "timeout")
                 elif kind == "transient" and transient_left > 0:
                     # Likely a secondary cancellation (CANCELLED): the device
@@ -307,7 +311,7 @@ class Scheduler:
                 )
                 self.table.mark_dead(worker)
                 metrics.bump("reassignments")
-                if isinstance(e, TimeoutError):
+                if isinstance(e, WorkerWaitTimeout):
                     metrics.bump("heartbeat_timeouts")
                 nxt = self.table.first_live()
                 if nxt is None:

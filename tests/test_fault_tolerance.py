@@ -750,6 +750,23 @@ def test_fused_path_latched_off_after_wedge(monkeypatch, mesh8):
     assert _time.monotonic() - t0 < 2.0  # went straight to the scheduler
 
 
+def test_taskpool_genuine_timeout_inside_attempt_propagates(monkeypatch):
+    """A TimeoutError raised INSIDE a shard attempt (e.g. IO on a network
+    mount) is not a lapsed heartbeat wait: it surfaces instead of silently
+    reassigning the shard (only WorkerWaitTimeout means 'worker hung')."""
+    sched = make_sched()
+
+    def boom(worker, data):
+        raise TimeoutError("nfs io timed out")
+
+    monkeypatch.setattr(sched.executor, "sort_shard", boom)
+    m = Metrics()
+    with pytest.raises(TimeoutError, match="nfs io"):
+        sched.run_job(gen_uniform(4_000, seed=97), metrics=m)
+    assert "heartbeat_timeouts" not in m.counters
+    assert "reassignments" not in m.counters
+
+
 def test_warm_shapes_keyed_per_device():
     """Compile grace is granted per (device, shape, dtype, kernel): warming a
     shape on worker 0 must not strip worker 1's first-attempt grace (ADVICE
