@@ -7,3 +7,9 @@ from dsort_tpu.ops.local_sort import (  # noqa: F401
     sort_padded,
 )
 from dsort_tpu.ops.radix import radix_sort, radix_sort_kv  # noqa: F401
+from dsort_tpu.ops.block_sort import (  # noqa: F401
+    block_merge_runs,
+    block_merge_runs_kv,
+    block_sort,
+    block_sort_pairs,
+)
