@@ -272,6 +272,8 @@ def _bench_suite(args) -> int:
 
     mesh = local_device_mesh()
     reps = args.reps
+    if reps < 1:
+        raise SystemExit("--reps must be >= 1")
 
     def timed(label, n, unit, fn, **extra):
         fn()  # warm/compile
@@ -280,7 +282,10 @@ def _bench_suite(args) -> int:
             t0 = time.perf_counter()
             fn()
             times.append(time.perf_counter() - t0)
-        dt = float(np.median(times))
+        # min, not median: tunnel jitter is one-sided additive noise (same
+        # doctrine as bench.py's chain timing), and the ladder's end-to-end
+        # numbers were swinging ~3x between runs on the median.
+        dt = float(min(times))
         line = {
             "metric": label,
             "value": round(n / dt, 1),
@@ -348,6 +353,8 @@ def _bench_suite(args) -> int:
 def cmd_bench(args) -> int:
     from dsort_tpu.data.ingest import gen_uniform
 
+    if args.reps < 1:
+        raise SystemExit("--reps must be >= 1")
     if args.suite:
         return _bench_suite(args)
     cfg = _load_config(args)
@@ -359,15 +366,14 @@ def cmd_bench(args) -> int:
         t0 = time.perf_counter()
         sorter(data, Metrics())
         times.append(time.perf_counter() - t0)
-    dt = float(np.median(times))
-    ref = 16_384 / 0.374  # BASELINE.md measured reference throughput
+    dt = float(min(times))  # one-sided tunnel jitter; see _bench_suite
     print(
         json.dumps(
             {
                 "metric": f"sort_throughput_{np.dtype(cfg.job.key_dtype)}_{args.n}_keys_{args.mode}",
                 "value": round(args.n / dt, 1),
                 "unit": "keys/sec",
-                "vs_baseline": round(args.n / dt / ref, 2),
+                "vs_baseline": round(args.n / dt / _REF_KEYS_PER_SEC, 2),
             }
         )
     )

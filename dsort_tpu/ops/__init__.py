@@ -7,9 +7,8 @@ from dsort_tpu.ops.local_sort import (  # noqa: F401
     sort_padded,
 )
 from dsort_tpu.ops.radix import radix_sort, radix_sort_kv  # noqa: F401
-from dsort_tpu.ops.block_sort import (  # noqa: F401
-    block_merge_runs,
-    block_merge_runs_kv,
-    block_sort,
-    block_sort_pairs,
-)
+
+# NOTE: the flagship kernels live in `dsort_tpu.ops.block_sort` (block_sort,
+# block_sort_pairs, block_merge_runs, block_merge_runs_kv) and are imported
+# from the submodule directly — re-exporting `block_sort` here would shadow
+# the submodule attribute with the function of the same name.
