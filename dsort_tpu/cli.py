@@ -271,9 +271,7 @@ def _bench_suite(args) -> int:
     from dsort_tpu.scheduler import FaultInjector, SpmdScheduler
 
     mesh = local_device_mesh()
-    reps = args.reps
-    if reps < 1:
-        raise SystemExit("--reps must be >= 1")
+    reps = args.reps  # validated by cmd_bench before dispatch
 
     def timed(label, n, unit, fn, **extra):
         fn()  # warm/compile

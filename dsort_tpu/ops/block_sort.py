@@ -22,7 +22,14 @@ a merge-block boundary.  The pass structure:
   run as one fused pass per 4x block widening (a no-op at the defaults,
   where the K1 tile already spans the full merge block; exercised by tests
   and non-default tile/block configurations).
-- **K2 (cross stage)**: for exchange distances of ``m > MULTI_M_HI`` blocks,
+- **K2c (orbit pass)**: ALL of one merge level's cross stages above the
+  span run in ONE pass.  A ``(hi, mid, stride, rows, 128)`` view gathers
+  the ``mid`` blocks reachable by the level's large exchange distances
+  into VMEM (strided rectangular DMA), so the level moves 2n bytes once
+  instead of once per stage; the whole orbit sits inside one direction
+  window, so ``asc`` is a grid-step scalar — the cheapest stage form.
+- **K2 (cross stage)**: single-stage fallback for distances whose orbit
+  would exceed the VMEM cap (``ORBIT_MID_MAX``; first reached at 2^28):
   each grid step owns a whole pair via a ``(pairs, 2, m, rows, 128)`` view
   (one strided rectangular DMA per side) and writes both members — 2n bytes
   per stage.
@@ -39,7 +46,9 @@ a merge-block boundary.  The pass structure:
 
 K2/K2b/K3 take the merge level as an SMEM scalar, so one compilation serves
 every level.  Total HBM passes for 2^24 at the defaults: 1 (K1) + 1 (K2a) +
-6 (K2) + 3 (K2b/K3) = 11, vs ~250 for ``lax.sort``.
+3 (K2c) + 3 (K2b/K3) = 8, vs ~250 for ``lax.sort`` (r4 final; the orbit
+pass replaced 6 per-stage K2 crosses — at 2^26 it replaces 15 with 5,
+measured kernel-level 44.5 -> 39.7 ms).
 
 Measured pass costs at 2^24 int32 (v5e via tunnel, slope method; r4
 numbers normalized across probe sessions by the unchanged-K1 drift —
@@ -51,7 +60,13 @@ tunnel state swings ~15% between sessions, so treat per-pass rows as
   ====================  ========  ======================================
   K1 tile sort          3.32-3.38 ~92% of VPU ops bound (~3.0 ms: 125
                                   row-stages x ~5 + 28 lane x ~13 ops)
+  K2c orbit (per level) ~0.2      at DMA bound — one 2n-byte residency
+                                  runs q stages where K2 paid 2n bytes
+                                  per stage (kernel-level: 7.87->7.63 ms
+                                  at 2^24, 44.5->39.7 ms at 2^26;
+                                  sessions swing +-10%)
   K2 cross (any m)      0.19-.21  at DMA bound (2n bytes @ ~725 GB/s, r3)
+                                  — now only the >ORBIT_MID_MAX fallback
   K2b/K3 span-tail      0.69-.76  FLAT across kb (r4; r3's kb-dependence
                                   0.43->0.90 is gone — runtime
                                   predication folds into the swap mask
@@ -62,8 +77,9 @@ tunnel state swings ~15% between sessions, so treat per-pass rows as
                                   ~0.5 ms ops bound is the pair-view
                                   reshape data movement.
   K2a span_low          1.70-1.93 4 fused levels (~57 stages)
-  full kernel           7.9       same-session slope vs lax (r3: 8.6);
-                                  ~85% VPU-bound
+  full kernel           7.63      same-session slope (r4 final, with the
+                                  orbit pass; pre-orbit r4: 7.87, r3:
+                                  8.6); ~88% VPU-bound
   ====================  ========  ======================================
 
 The kernel is compute-bound on the VPU, not HBM-bound: total DMA is only
@@ -637,6 +653,92 @@ def _cross(xs, k_over_b, rows: int, m: int, interpret: bool):
     return tuple(o.reshape(xs[0].shape) for o in out)
 
 
+def _orbit_kernel(*refs, mid: int, rows: int, kb_shift: int, np_: int):
+    """K2c: ALL of one merge level's cross stages above the span — one pass.
+
+    The input view gathers the ``mid`` blocks reachable from base block
+    ``hi*mid*stride + lo`` by the level's large exchange distances (one
+    strided rectangular DMA per plane), so the stages at block distances
+    ``mid*stride/2 .. stride`` all run on VMEM-resident data: the level
+    moves 2n bytes ONCE where per-stage K2 crosses moved 2n bytes per
+    stage.  The whole orbit sits inside one direction window of the level
+    (``kb >= mid*stride``), so ``asc`` is a grid-step *scalar* — every
+    stage takes the cheapest pair-view min/max form, no masks at all.
+    ``kb_shift`` locates the level's direction bit within ``hi`` (0 when
+    the orbit is uncapped and covers the level's whole distance range).
+    """
+    import jax.experimental.pallas as pl
+
+    asc = ((pl.program_id(0) >> kb_shift) & 1) == 0
+    xs = tuple(r[0, :, 0].reshape(mid * rows, LANES) for r in refs[:np_])
+    d = mid // 2
+    while d >= 1:
+        xs = _exchange_rows(xs, d * rows, asc)
+        d //= 2
+    for o_ref, x in zip(refs[np_:], xs):
+        o_ref[0, :, 0] = x.reshape(mid, rows, LANES)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rows", "mid", "stride", "kb_shift", "interpret")
+)
+def _orbit(xs, rows: int, mid: int, stride: int, kb_shift: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    total_rows = xs[0].shape[0]
+    hi_cnt = total_rows // (mid * stride * rows)
+    x5 = tuple(x.reshape(hi_cnt, mid, stride, rows, LANES) for x in xs)
+    spec = pl.BlockSpec(
+        (1, mid, 1, rows, LANES),
+        lambda h, s: (h, 0, s, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    with jax.enable_x64(False):  # see _tile_sort_cm
+        out = pl.pallas_call(
+            functools.partial(
+                _orbit_kernel, mid=mid, rows=rows, kb_shift=kb_shift,
+                np_=len(xs),
+            ),
+            out_shape=_shapes(x5),
+            grid=(hi_cnt, stride),
+            in_specs=[spec] * len(xs),
+            out_specs=tuple([spec] * len(xs)),
+            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 << 20),
+            interpret=interpret,
+        )(*x5)
+    return tuple(o.reshape(xs[0].shape) for o in out)
+
+
+# VMEM cap on the orbit's mid axis (blocks per slab, single-plane): slabs
+# pipeline as in+out x double-buffer, so 32 x 512 KiB x 4 = 64 MiB at the
+# defaults.  Levels wider than the cap peel their top stages as K2 singles
+# (first reached at 2^28 int32 / 2^27 int64 at default block_rows).
+ORBIT_MID_MAX = 32
+
+
+def _cross_stages(xs, kb_blocks, rows, span_m, nplanes, interpret):
+    """One level's cross stages at block distances ``> span_m``: as few
+    orbit (K2c) passes as the VMEM cap allows — usually exactly one — with
+    K2 singles peeling distances too wide for a capped orbit."""
+    kb = None
+    m = kb_blocks // 2
+    stride = 2 * span_m
+    mid_cap = max(ORBIT_MID_MAX // nplanes, 2)
+    while m > span_m and 2 * m // stride > mid_cap:
+        if kb is None:
+            kb = jnp.full((1, 1), kb_blocks, jnp.int32)
+        xs = _as_tuple(_cross(xs, kb, rows, m, interpret), nplanes)
+        m //= 2
+    if m > span_m:
+        mid = 2 * m // stride
+        kb_shift = (kb_blocks // (mid * stride)).bit_length() - 1
+        xs = _as_tuple(
+            _orbit(xs, rows, mid, stride, kb_shift, interpret), nplanes
+        )
+    return xs
+
+
 @functools.partial(jax.jit, static_argnames=("rows", "m_hi", "interpret"))
 def _span_tail(xs, k_over_b, rows: int, m_hi: int, interpret: bool):
     import jax.experimental.pallas as pl
@@ -703,10 +805,7 @@ def _sort_planes(
     k = 4 * span_m * b
     while k <= p:
         kb = jnp.full((1, 1), k // b, jnp.int32)
-        m = k // (2 * b)
-        while m > span_m:
-            xs = _as_tuple(_cross(xs, kb, blk, m, interpret), nplanes)
-            m //= 2
+        xs = _cross_stages(xs, k // b, blk, span_m, nplanes, interpret)
         xs = _as_tuple(_span_tail(xs, kb, blk, span_m, interpret), nplanes)
         k *= 2
     return xs
@@ -752,10 +851,7 @@ def _merge_planes(
         k = k0
     while k <= p:
         kb = jnp.full((1, 1), k // b, jnp.int32)
-        m = k // (2 * b)
-        while m > span_m:
-            xs = _as_tuple(_cross(xs, kb, cap, m, interpret), nplanes)
-            m //= 2
+        xs = _cross_stages(xs, k // b, cap, span_m, nplanes, interpret)
         xs = _as_tuple(_span_tail(xs, kb, cap, span_m, interpret), nplanes)
         k *= 2
     return xs

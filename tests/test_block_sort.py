@@ -122,6 +122,34 @@ def test_block_sort_rejects_2d():
         block_sort(jnp.zeros((64, 128), jnp.int32), interpret=True)
 
 
+def test_orbit_pass_multi_level():
+    """128 blocks at block_rows=8: levels kb=64 and kb=128 each run their
+    m>span cross stages as ONE K2c orbit pass (mid 4 and 8) — the r4 pass
+    that replaced per-stage K2 crosses.  Exactness over the full array pins
+    both the strided view's block mapping and the grid-scalar directions."""
+    rng = np.random.default_rng(12)
+    x = rng.integers(-(2**31), 2**31, 1 << 17, dtype=np.int64).astype(np.int32)
+    out = np.asarray(
+        block_sort(jnp.asarray(x), block_rows=8, tile_rows=8, interpret=True)
+    )
+    np.testing.assert_array_equal(out, np.sort(x))
+
+
+def test_orbit_cap_peels_k2_singles(monkeypatch):
+    """With ORBIT_MID_MAX forced to 2, wide levels peel their top cross
+    stages as K2 singles before the capped orbit — the >=2^28 fallback path
+    exercised at test scale.  kb_shift > 0 directions are what this pins."""
+    import dsort_tpu.ops.block_sort as B
+
+    monkeypatch.setattr(B, "ORBIT_MID_MAX", 2)
+    rng = np.random.default_rng(13)
+    x = rng.integers(-(2**31), 2**31, 1 << 17, dtype=np.int64).astype(np.int32)
+    out = np.asarray(
+        block_sort(jnp.asarray(x), block_rows=8, tile_rows=8, interpret=True)
+    )
+    np.testing.assert_array_equal(out, np.sort(x))
+
+
 def test_auto_kernel_keeps_floats_on_lax(monkeypatch):
     """auto must never hand raw floats (possible NaNs) to the min/max network."""
     import dsort_tpu.ops.pallas_sort as ps
