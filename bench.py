@@ -356,7 +356,10 @@ def main() -> None:
 
         import functools
 
-        cm1, cm2 = 24, 144
+        # us-scale ops need long chains or tunnel jitter swamps the slope
+        # (a 24/144 pair once measured the full re-sort at an impossible
+        # 0.028 ms/merge); traced lengths make long chains compile-free.
+        cm1, cm2 = 96, 576
         variants = {
             "block_merge": lambda v: block_merge_runs(v),
             "full_resort": lambda v: block_sort(v.reshape(-1)),

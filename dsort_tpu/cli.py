@@ -271,7 +271,9 @@ def _bench_suite(args) -> int:
     from dsort_tpu.scheduler import FaultInjector, SpmdScheduler
 
     mesh = local_device_mesh()
-    reps = args.reps  # validated by cmd_bench before dispatch
+    reps = args.reps
+    if reps < 1:  # bench.py calls _bench_suite directly, not via cmd_bench
+        raise SystemExit("--reps must be >= 1")
 
     def timed(label, n, unit, fn, **extra):
         fn()  # warm/compile

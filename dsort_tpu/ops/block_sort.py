@@ -22,17 +22,21 @@ a merge-block boundary.  The pass structure:
   run as one fused pass per 4x block widening (a no-op at the defaults,
   where the K1 tile already spans the full merge block; exercised by tests
   and non-default tile/block configurations).
-- **K2c (orbit pass)**: ALL of one merge level's cross stages above the
-  span run in ONE pass.  A ``(hi, mid, stride, rows, 128)`` view gathers
-  the ``mid`` blocks reachable by the level's large exchange distances
-  into VMEM (strided rectangular DMA), so the level moves 2n bytes once
-  instead of once per stage; the whole orbit sits inside one direction
-  window, so ``asc`` is a grid-step scalar — the cheapest stage form.
-- **K2 (cross stage)**: single-stage fallback for distances whose orbit
-  would exceed the VMEM cap (``ORBIT_MID_MAX``; first reached at 2^28):
-  each grid step owns a whole pair via a ``(pairs, 2, m, rows, 128)`` view
-  (one strided rectangular DMA per side) and writes both members — 2n bytes
-  per stage.
+- **K2c (orbit pass, single-plane keys)**: ALL of one merge level's cross
+  stages above the span run in ONE pass.  A ``(hi, mid, stride, rows,
+  128)`` view gathers the ``mid`` blocks reachable by the level's large
+  exchange distances into VMEM (strided rectangular DMA), so the level
+  moves 2n bytes once instead of once per stage; the whole orbit sits
+  inside one direction window, so ``asc`` is a grid-step scalar — the
+  cheapest stage form.  Multi-plane (64-bit/kv) keys do NOT use it: the
+  same-session A/B measured the lexicographic swap-mask exchange ~3x
+  slower per byte in the orbit slab than in K2's pair view (see
+  `_cross_stages`), so wide keys keep per-stage crosses.
+- **K2 (cross stage)**: per-stage pass for multi-plane keys, and the
+  fallback for distances whose orbit would exceed the VMEM cap
+  (``ORBIT_MID_MAX``; first reached at 2^28): each grid step owns a whole
+  pair via a ``(pairs, 2, m, rows, 128)`` view (one strided rectangular
+  DMA per side) and writes both members — 2n bytes per stage.
 - **K2b (multi-cross)**: distances ``2..MULTI_M_HI`` blocks fuse into ONE
   span pass (vreg-aligned row exchanges inside a 16-block VMEM span).
 - **K3 (pair merge tail)**: one grid step owns a contiguous block pair,
@@ -45,10 +49,10 @@ a merge-block boundary.  The pass structure:
   static stage lists, replacing four per-level span-tail passes.
 
 K2/K2b/K3 take the merge level as an SMEM scalar, so one compilation serves
-every level.  Total HBM passes for 2^24 at the defaults: 1 (K1) + 1 (K2a) +
-3 (K2c) + 3 (K2b/K3) = 8, vs ~250 for ``lax.sort`` (r4 final; the orbit
-pass replaced 6 per-stage K2 crosses — at 2^26 it replaces 15 with 5,
-measured kernel-level 44.5 -> 39.7 ms).
+every level.  Total HBM passes for int32 2^24 at the defaults: 1 (K1) +
+1 (K2a) + 3 (K2c) + 3 (K2b/K3) = 8, vs ~250 for ``lax.sort`` (r4 final;
+the orbit pass replaced 6 per-stage K2 crosses, and 15 with 5 at 2^26 —
+same-session A/B: 8.77 -> 8.33 ms at 2^24, 47.95 -> 40.52 ms at 2^26).
 
 Measured pass costs at 2^24 int32 (v5e via tunnel, slope method; r4
 numbers normalized across probe sessions by the unchanged-K1 drift —
@@ -62,11 +66,14 @@ tunnel state swings ~15% between sessions, so treat per-pass rows as
                                   row-stages x ~5 + 28 lane x ~13 ops)
   K2c orbit (per level) ~0.2      at DMA bound — one 2n-byte residency
                                   runs q stages where K2 paid 2n bytes
-                                  per stage (kernel-level: 7.87->7.63 ms
-                                  at 2^24, 44.5->39.7 ms at 2^26;
-                                  sessions swing +-10%)
+                                  per stage.  Same-session A/B vs
+                                  per-stage crosses: 8.77->8.33 ms at
+                                  2^24, 47.95->40.52 ms at 2^26 (int32);
+                                  int64 measured a 0.5 ms LOSS, so
+                                  multi-plane keys keep K2 (see
+                                  _cross_stages)
   K2 cross (any m)      0.19-.21  at DMA bound (2n bytes @ ~725 GB/s, r3)
-                                  — now only the >ORBIT_MID_MAX fallback
+                                  — multi-plane keys + >ORBIT_MID_MAX
   K2b/K3 span-tail      0.69-.76  FLAT across kb (r4; r3's kb-dependence
                                   0.43->0.90 is gone — runtime
                                   predication folds into the swap mask
@@ -77,13 +84,14 @@ tunnel state swings ~15% between sessions, so treat per-pass rows as
                                   ~0.5 ms ops bound is the pair-view
                                   reshape data movement.
   K2a span_low          1.70-1.93 4 fused levels (~57 stages)
-  full kernel           7.63      same-session slope (r4 final, with the
-                                  orbit pass; pre-orbit r4: 7.87, r3:
-                                  8.6); ~88% VPU-bound
+  full kernel           7.6-8.3   slope, session-dependent (the A/B
+                                  session read 8.33 with / 8.77 without
+                                  the orbit; an earlier same-day session
+                                  read 7.63; r3: 8.6); ~88% VPU-bound
   ====================  ========  ======================================
 
 The kernel is compute-bound on the VPU, not HBM-bound: total DMA is only
-~11 x 0.17 ms.  Further gains must cut *stages* (hence K2a's fusion) or
+~8 x 0.17 ms.  Further gains must cut *stages* (hence K2a's fusion) or
 per-stage ops; the stage formulations below are already the cheapest of
 the measured alternatives (see also the MXU go/no-go below).
 
@@ -718,14 +726,20 @@ ORBIT_MID_MAX = 32
 
 
 def _cross_stages(xs, kb_blocks, rows, span_m, nplanes, interpret):
-    """One level's cross stages at block distances ``> span_m``: as few
-    orbit (K2c) passes as the VMEM cap allows — usually exactly one — with
-    K2 singles peeling distances too wide for a capped orbit."""
+    """One level's cross stages at block distances ``> span_m``: one orbit
+    (K2c) pass for single-plane keys — with K2 singles peeling distances
+    too wide for a VMEM-capped orbit — and per-stage K2 crosses for
+    multi-plane keys, where the A/B measured the orbit LOSING (r4,
+    same-session at 2^23 int64: 10.82 ms orbit vs 10.32 ms per-stage —
+    the swap-mask lexicographic exchange runs ~3x slower per byte in the
+    orbit's reshaped slab than in K2's pair view, outweighing the saved
+    passes; single-plane orbits use scalar-direction min/max and win:
+    8.33 vs 8.77 ms at 2^24, 40.5 vs 48.0 ms at 2^26)."""
     kb = None
     m = kb_blocks // 2
     stride = 2 * span_m
-    mid_cap = max(ORBIT_MID_MAX // nplanes, 2)
-    while m > span_m and 2 * m // stride > mid_cap:
+    orbit_cap = ORBIT_MID_MAX if nplanes == 1 else 0
+    while m > span_m and 2 * m // stride > orbit_cap:
         if kb is None:
             kb = jnp.full((1, 1), kb_blocks, jnp.int32)
         xs = _as_tuple(_cross(xs, kb, rows, m, interpret), nplanes)

@@ -234,6 +234,20 @@ def test_block_merge_runs_matches_sort(r, l):
     np.testing.assert_array_equal(out, np.sort(runs.reshape(-1)))
 
 
+def test_block_merge_runs_through_orbit_levels():
+    """64 one-block runs at block_rows=8: the merge driver's upper levels
+    run their above-span cross stages as K2c orbit passes (mid 4 and 8) —
+    the merge-entry counterpart of test_orbit_pass_multi_level."""
+    from dsort_tpu.ops.block_sort import block_merge_runs
+
+    rng = np.random.default_rng(77)
+    runs = _sorted_runs(rng, 64, 1024)
+    out = np.asarray(
+        block_merge_runs(jnp.asarray(runs), block_rows=8, interpret=True)
+    )
+    np.testing.assert_array_equal(out, np.sort(runs.reshape(-1)))
+
+
 @pytest.mark.parametrize("dtype", [np.uint32, np.int64, np.uint64])
 def test_block_merge_runs_dtypes(dtype):
     from dsort_tpu.ops.block_sort import block_merge_runs

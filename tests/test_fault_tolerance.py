@@ -132,6 +132,25 @@ def test_spmd_scheduler_mesh_reform(mesh8):
     assert len(sched.table.live_workers()) == 7
 
 
+def test_spmd_cascading_device_loss(mesh8):
+    """Two devices die in SUCCESSIVE attempts: the first loss re-forms the
+    mesh over 7, the retry loses another participant and re-forms again
+    over 6 — reassign-on-failure composes across re-formations (the
+    reference survives repeated worker deaths the same way: every retry
+    rescans liveness, ``server.c:367-401``)."""
+    inj = FaultInjector()
+    inj.fail_once(2, "spmd")
+    inj.fail_once(5, "spmd")
+    sched = SpmdScheduler(job=FAST, injector=inj)
+    data = gen_uniform(50_000, seed=29)
+    m = Metrics()
+    out = sched.sort(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters["mesh_reforms"] == 2
+    assert len(sched.table.live_workers()) == 6
+    assert not sched.table.is_alive(2) and not sched.table.is_alive(5)
+
+
 def test_spmd_scheduler_all_dead(mesh8):
     inj = FaultInjector()
     ndev = len(SpmdScheduler(job=FAST).devices)

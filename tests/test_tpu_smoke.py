@@ -36,6 +36,28 @@ def test_block_sort_on_chip():
 
 
 @on_tpu
+def test_block_sort_orbit_levels_on_chip():
+    """Hardware gate for the deep cross levels (r4 final): the K2c orbit
+    pass — strided 5-D view + grid-scalar directions — must legalize under
+    Mosaic at sizes where it actually runs (>= 64 merge blocks; every
+    smaller smoke size never reaches it).  Element-exact against np.sort:
+    int32 takes the orbit path, int64 pins the multi-plane per-stage K2
+    path at the same depth (the A/B kept wide keys off the orbit); +5
+    keeps the pad/trim path honest at these sizes too."""
+    from dsort_tpu.ops.block_sort import block_sort
+
+    rng = np.random.default_rng(40)
+    x32 = rng.integers(-(2**31), 2**31 - 1, (1 << 23) + 5, dtype=np.int64)
+    x32 = x32.astype(np.int32)
+    out = np.asarray(block_sort(jnp.asarray(x32), interpret=False))
+    np.testing.assert_array_equal(out, np.sort(x32))
+
+    x64 = rng.integers(-(2**62), 2**62, 1 << 23, dtype=np.int64)
+    out64 = np.asarray(block_sort(jnp.asarray(x64), interpret=False))
+    np.testing.assert_array_equal(out64, np.sort(x64))
+
+
+@on_tpu
 def test_pallas_tile_sort_on_chip():
     from dsort_tpu.ops.pallas_sort import pallas_sort
 
