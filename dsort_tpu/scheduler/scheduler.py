@@ -666,7 +666,7 @@ class SpmdScheduler:
 
     def run_bounded(
         self, fn, n_keys: int, tag: str = "prog", lane_key=None,
-        cancel_event: threading.Event | None = None,
+        cancel_event: threading.Event | None = None, boost: float = 1.0,
     ):
         """Run a whole device program under the bounded-wait discipline.
 
@@ -691,12 +691,20 @@ class SpmdScheduler:
         retry then queues behind the still-compiling attempt on the same
         lane and completes from the warmed executable, so the job converges
         — it just pays one spurious probe round.
+
+        ``boost`` multiplies the budget; the sort loop passes
+        ``2**wait_lapses`` (healthy-probe timeouts ONLY — generic transient
+        errors don't inflate it) so successive lapsed waits get
+        geometrically more time — a compile service running pathologically
+        slow (observed r4: the SAME kernel set compiling 1 min one session
+        and ~8 min another) delays the job instead of failing it, while a
+        genuinely wedged chip still fails its probe on the first lapse.
         """
         key = lane_key if lane_key is not None else (
             (tag,) + tuple(d.id for d in self.devices)
         )
         warm = (key, _size_bucket(n_keys))
-        budget = self._wait_budget(n_keys, warm in self._warm_waits)
+        budget = boost * self._wait_budget(n_keys, warm in self._warm_waits)
         box, done, abandoned = self._mesh_lane(key).submit(fn)
         if not done.wait(timeout=budget):
             abandoned.set()
@@ -746,6 +754,12 @@ class SpmdScheduler:
                     "cleared", job_id,
                 )
         transient_retries = 0
+        # Counts only healthy-probe WAIT lapses (not generic transient
+        # runtime errors): the budget boost below must grow only when the
+        # wait itself proved too short — a fast CANCELLED retry says
+        # nothing about compile speed and must not inflate hang-detection
+        # windows (review r4).
+        wait_lapses = 0
         while True:
             live = self.table.live_workers()
             if not live:
@@ -799,6 +813,7 @@ class SpmdScheduler:
                     attempt, len(data), tag="spmd",
                     lane_key=("spmd",) + tuple(d.id for d in devs),
                     cancel_event=cancelled,
+                    boost=float(2 ** wait_lapses),
                 )
                 for i in live:  # proof of life: the collective completed
                     self.table.heartbeat(i)
@@ -830,6 +845,7 @@ class SpmdScheduler:
                     metrics.bump("mesh_reforms")
                 elif transient_retries < self.job.max_transient_retries:
                     transient_retries += 1
+                    wait_lapses += 1
                     metrics.bump("transient_retries")
                     log.warning(
                         "in-flight wait timed out with all devices healthy "

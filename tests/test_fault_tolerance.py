@@ -626,6 +626,31 @@ def test_spmd_inflight_hang_healthy_devices_retries(mesh8):
     assert sched.table.live_workers() == list(range(len(sched.devices)))
 
 
+def test_spmd_healthy_timeout_budget_grows(mesh8):
+    """Successive healthy-probe timeouts double the wait budget (boost =
+    2**transient_retries): a stall longer than retries x the flat budget —
+    a compile service running pathologically slow — delays the job instead
+    of failing it.  With the flat budget this schedule exhausts at 3 x
+    0.6 s (+ probe overhead) well before the 3.5 s stall clears; the
+    geometric windows 0.6/1.2/2.4 reach ~4.2 s cumulative and the queued
+    retry completes there.  The 3.5 s stall leaves ~0.85 s of slack on
+    BOTH sides for probe/resubmit overhead on a loaded machine."""
+    import dataclasses
+
+    inj = FaultInjector()
+    job = dataclasses.replace(HANG_FAST, max_transient_retries=2)
+    sched = SpmdScheduler(job=job, injector=inj)
+    data = gen_uniform(30_000, seed=93)
+    out0 = sched.sort(data)  # pre-warm: compile off the clock
+    np.testing.assert_array_equal(out0, np.sort(data))
+    inj.hang_once(0, "spmd", seconds=3.5)
+    m = Metrics()
+    out = sched.sort(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters["transient_retries"] >= 2  # needed the grown windows
+    assert sched.table.live_workers() == list(range(len(sched.devices)))
+
+
 def test_probe_respects_injector(mesh8):
     """A wedged device can be modeled at the probe itself."""
     inj = FaultInjector()
