@@ -142,7 +142,15 @@ def _make_sorter(cfg: SortConfig, mode: str):
                         classify_runtime_error(e) is None
                     ):
                         raise  # genuine program error, not a device loss/hang
-                    if isinstance(e, ProgramWaitTimeout):
+                    if isinstance(e, ProgramWaitTimeout) and not getattr(
+                        e, "cold", False
+                    ):
+                        # Only a WARM lapse (the fused executable had
+                        # completed here before) latches the path off — a
+                        # cold lapse is likely the one-time compile running
+                        # long (observed r4: ~5 min vs a 30-150 s grace);
+                        # the compile continues on its lane, warms the jit
+                        # cache, and the next small job tries fused again.
                         fused_wedged.set()
                     metrics.bump("fused_fallbacks")
                     log.warning(

@@ -212,7 +212,9 @@ class Scheduler:
             0.0 if warm_key in self._warm_shapes else self.job.compile_grace_s
         )
 
-    def _attempt(self, worker: int, shard: np.ndarray) -> np.ndarray:
+    def _attempt(
+        self, worker: int, shard: np.ndarray, metrics: Metrics | None = None
+    ) -> np.ndarray:
         """One exchange attempt on one worker, bounded by the heartbeat timeout.
 
         Runs on the worker's OWN daemon lane (`_AttemptLane`) so a hung
@@ -221,8 +223,20 @@ class Scheduler:
         worker; the reference cannot detect a hung worker at all.  A second
         attempt on a previously-hung worker serializes behind the stuck call
         on that worker's lane; the timeout fires again and the shard moves
-        on.  The worker is marked dead on the first timeout, so in practice
-        no new shards land on a hung device.
+        on.  The worker is marked dead on the first WARM-key timeout, so in
+        practice no new shards land on a hung device.
+
+        A lapsed COLD-key wait (this (device, shape) never compiled here,
+        and the budget included compile grace) is ambiguous — the attempt
+        may be inside a slow Mosaic compile, not hung (observed r4: the
+        same kernel set compiling 1 min one session and ~8 min another, vs
+        compile_grace_s sized for the documented 30-150 s).  The wait then
+        EXTENDS on the same in-flight attempt with doubled windows (1x +
+        2x + 4x the budget in total) before the worker is declared hung —
+        no resubmit, so the shard is never sorted twice, and each worker
+        a shard migrates to gets its own cold windows.  With
+        compile_grace_s=0 the operator asserts compiles are instant, so a
+        cold lapse is a hang like any other.
         """
         import functools
 
@@ -235,7 +249,23 @@ class Scheduler:
         # read as a hung worker, so the first attempt per combo gets extra
         # grace, independently per device.
         key = self._warm_key(worker, shard)
-        if not done.wait(timeout=self._timeout_for(key)):
+        cold = key not in self._warm_shapes and self.job.compile_grace_s > 0
+        budget = self._timeout_for(key)
+        windows = [budget, 2 * budget, 4 * budget] if cold else [budget]
+        ok = False
+        for n, w in enumerate(windows):
+            if done.wait(timeout=w):
+                ok = True
+                break
+            if n < len(windows) - 1:
+                if metrics is not None:
+                    metrics.bump("cold_wait_retries")
+                log.warning(
+                    "cold-key wait lapsed on worker %d — extending to a "
+                    "%dx window (likely slow compile, not a hang)",
+                    worker, 2 ** (n + 1),
+                )
+        if not ok:
             abandoned.set()  # if still queued, it will be skipped, not run
             raise WorkerWaitTimeout(f"worker {worker} heartbeat timeout")
         if "e" in box:
@@ -269,7 +299,7 @@ class Scheduler:
                 if worker is None:
                     return  # clean abort; job-level gate raises
             try:
-                results[i] = self._attempt(worker, shard)
+                results[i] = self._attempt(worker, shard, metrics)
                 if ckpt is not None:
                     ckpt.save(i, results[i])
                 return  # result pinned to slot i (server.c:415)
@@ -710,9 +740,15 @@ class SpmdScheduler:
             abandoned.set()
             if cancel_event is not None:
                 cancel_event.set()
-            raise ProgramWaitTimeout(
+            err = ProgramWaitTimeout(
                 f"in-flight program wait exceeded {budget:.1f}s on {key[0]}"
             )
+            # A lapse on a never-completed (lane, size) is ambiguous — the
+            # program may be inside a slow cold compile, not wedged.
+            # Callers use this to avoid permanent fallbacks (the fused
+            # small-job latch) on what is likely a one-time compile.
+            err.cold = warm not in self._warm_waits
+            raise err
         if "e" in box:
             raise box["e"]
         self._warm_waits.add(warm)

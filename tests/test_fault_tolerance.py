@@ -105,6 +105,49 @@ def test_hung_worker_detected_by_timeout():
     assert not sched.table.is_alive(0)
 
 
+def test_cold_key_slow_compile_not_killed():
+    """A first-contact stall on a (device, shape) whose budget included
+    compile grace retries the SAME worker with grown windows instead of
+    marking it dead — a slow Mosaic compile (observed r4: 488 s for a
+    30-150 s shape) must not read as a hang.  The stall (2.5 s) outlives
+    the 1.3 s cold budget but clears inside the doubled second window
+    (1.3 + 2.6 = 3.9 s), so the queued retry completes from the warmed
+    executable and the worker stays alive."""
+    inj = FaultInjector()
+    inj.hang_once(0, "sort", seconds=2.5)
+    job = JobConfig(settle_delay_s=0.01, heartbeat_timeout_s=0.3,
+                    compile_grace_s=1.0)
+    sched = Scheduler(DeviceExecutor(injector=inj), job)
+    data = gen_uniform(4_000, seed=61)
+    m = Metrics()
+    out = sched.run_job(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters["cold_wait_retries"] >= 1
+    assert m.counters.get("reassignments", 0) == 0
+    assert sched.table.is_alive(0)
+
+
+def test_cold_key_genuine_hang_still_dies():
+    """The cold-grace windows are bounded: a worker that hangs on first
+    contact exhausts 1x+2x+4x the cold budget (~3.5 s here) and is then
+    marked dead and reassigned like any hung worker.  The injected hang is
+    6 s — past every grown window, but short enough that device 0's
+    module-global attempt lane drains before later tests land work on it
+    (same constraint as test_hung_worker_detected_by_timeout)."""
+    inj = FaultInjector()
+    inj.hang_once(0, "sort", seconds=6.0)
+    job = JobConfig(settle_delay_s=0.01, heartbeat_timeout_s=0.2,
+                    compile_grace_s=0.3)
+    sched = Scheduler(DeviceExecutor(injector=inj), job)
+    data = gen_uniform(4_000, seed=62)
+    m = Metrics()
+    out = sched.run_job(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters["cold_wait_retries"] == 2
+    assert m.counters["heartbeat_timeouts"] >= 1
+    assert not sched.table.is_alive(0)
+
+
 def test_worker_table_first_live_linear_scan():
     t = WorkerTable(4)
     assert t.first_live() == 0
@@ -761,8 +804,11 @@ def test_genuine_timeout_inside_attempt_propagates(monkeypatch, mesh8):
 
 
 def test_fused_path_latched_off_after_wedge(monkeypatch, mesh8):
-    """After one fused-path wedge, later small jobs skip the fused attempt
-    (its lane thread is stuck forever) instead of paying a timeout each."""
+    """A WARM fused-path wedge latches the path off (its lane thread is
+    stuck forever) so later small jobs skip the fused attempt instead of
+    paying a timeout each.  The path is warmed by one clean job first —
+    a COLD lapse deliberately does not latch (see
+    test_fused_cold_lapse_does_not_latch)."""
     import time as _time
 
     import dsort_tpu.models.pipelines as pmod
@@ -772,26 +818,66 @@ def test_fused_path_latched_off_after_wedge(monkeypatch, mesh8):
     calls = {"n": 0}
     real = pmod.fused_sort_small
 
-    def hang_always_fused(data, kernel="auto", metrics=None):
+    def hang_after_first(data, kernel="auto", metrics=None):
         calls["n"] += 1
-        _time.sleep(30.0)
+        if calls["n"] > 1:
+            _time.sleep(30.0)
         return real(data, kernel, metrics)
 
-    monkeypatch.setattr(pmod, "fused_sort_small", hang_always_fused)
+    monkeypatch.setattr(pmod, "fused_sort_small", hang_after_first)
     cfg = SortConfig(job=HANG_FAST)
     sorter = cli._make_sorter(cfg, "spmd")
     data = gen_uniform(10_000, seed=96)
+    m0 = Metrics()
+    out0 = sorter(data, m0)  # clean: warms the fused (lane, size) bucket
+    np.testing.assert_array_equal(out0, np.sort(data))
+    assert m0.counters["fused_small_jobs"] == 1
     m1 = Metrics()
-    out1 = sorter(data, m1)  # wedges, falls back
+    out1 = sorter(data, m1)  # wedges on a WARM bucket -> falls back + latches
     np.testing.assert_array_equal(out1, np.sort(data))
     assert m1.counters["fused_fallbacks"] == 1
     t0 = _time.monotonic()
     m2 = Metrics()
-    out2 = sorter(data, m2)  # latched: no second fused attempt, no wait
+    out2 = sorter(data, m2)  # latched: no third fused attempt, no wait
     np.testing.assert_array_equal(out2, np.sort(data))
-    assert calls["n"] == 1
+    assert calls["n"] == 2
     assert "fused_fallbacks" not in m2.counters
     assert _time.monotonic() - t0 < 2.0  # went straight to the scheduler
+
+
+def test_fused_cold_lapse_does_not_latch(monkeypatch, mesh8):
+    """A COLD fused-path lapse — the first job paying a slow compile, not a
+    wedged chip — falls back for that job but does NOT latch the path off:
+    once the stall drains (the compile finishes and warms the executable),
+    the next small job uses the fused path again."""
+    import time as _time
+
+    import dsort_tpu.models.pipelines as pmod
+    from dsort_tpu import cli
+    from dsort_tpu.config import SortConfig
+
+    real = pmod.fused_sort_small
+    state = {"n": 0}
+
+    def stall_once(data, kernel="auto", metrics=None):
+        state["n"] += 1
+        if state["n"] == 1:
+            _time.sleep(3.0)  # > the 2.6 s cold budget, drains quickly
+        return real(data, kernel, metrics)
+
+    monkeypatch.setattr(pmod, "fused_sort_small", stall_once)
+    cfg = SortConfig(job=HANG_FAST)
+    sorter = cli._make_sorter(cfg, "spmd")
+    data = gen_uniform(10_000, seed=97)
+    m1 = Metrics()
+    out1 = sorter(data, m1)  # cold lapse -> fallback, NOT latched
+    np.testing.assert_array_equal(out1, np.sort(data))
+    assert m1.counters["fused_fallbacks"] == 1
+    _time.sleep(1.0)  # let the stalled first attempt drain off the lane
+    m2 = Metrics()
+    out2 = sorter(data, m2)  # fused path alive again
+    np.testing.assert_array_equal(out2, np.sort(data))
+    assert m2.counters.get("fused_small_jobs") == 1
 
 
 def test_taskpool_genuine_timeout_inside_attempt_propagates(monkeypatch):
