@@ -135,6 +135,18 @@ def test_orbit_pass_multi_level():
     np.testing.assert_array_equal(out, np.sort(x))
 
 
+def test_orbit_pass_uint32_sign_flip_path():
+    """uint32 keys ride the signed fast path (sign-bit flip) and are
+    single-plane, so they take the orbit pass too — pinned at a depth
+    (128 blocks at block_rows=8) where multi-stage orbits really run."""
+    rng = np.random.default_rng(14)
+    x = rng.integers(0, 2**32, 1 << 17, dtype=np.uint64).astype(np.uint32)
+    out = np.asarray(
+        block_sort(jnp.asarray(x), block_rows=8, tile_rows=8, interpret=True)
+    )
+    np.testing.assert_array_equal(out, np.sort(x))
+
+
 def test_orbit_cap_peels_k2_singles(monkeypatch):
     """With ORBIT_MID_MAX forced to 2, wide levels peel their top cross
     stages as K2 singles before the capped orbit — the >=2^28 fallback path
