@@ -105,7 +105,11 @@ def _chain_runner(sort_fn, x):
     f = jax.jit(
         lambda a, c: lax.fori_loop(0, c, lambda i, v: sort_fn(v ^ i), a)
     )
-    y = f(x, 2)  # compile + warm
+    # np.int32 pins the traced length's dtype: a bare Python int is a WEAK
+    # scalar whose aval flips int32 -> int64 when the suite enables x64
+    # mid-run, silently recompiling the whole chain executable (minutes
+    # through a cold compile service) on the next call.
+    y = f(x, np.int32(2))  # compile + warm
     out_head = np.asarray(y[: 1 << 16])  # materialize = warm run completed
     assert (np.diff(out_head) >= 0).all(), "bench output not sorted"
     return f
@@ -117,7 +121,8 @@ def _chain_total(f, x, chain: int, reps: int) -> float:
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        _ = np.asarray(f(x, chain)[-1:])  # tiny D2H copy = completion barrier
+        # tiny D2H copy = completion barrier; np.int32: see _chain_runner
+        _ = np.asarray(f(x, np.int32(chain))[-1:])
         times.append(time.perf_counter() - t0)
     return float(min(times))
 
@@ -152,7 +157,9 @@ def _slope_fields(per, fixed, chained, n_items, c1, c2) -> dict:
 
 
 def _emit_slope(name: str, n_items: int, unit: str, sort_fn, x, c1, c2, reps,
-                baseline: bool = True, **extra) -> None:
+                baseline: bool = True, **extra):
+    """Emit one slope-timed line; returns ``(runner, per, fixed, chained)``
+    so callers can re-measure the same executable later (drift sensor)."""
     f = _chain_runner(sort_fn, x)
     per, fixed, chained = _slope_of(
         lambda c: _chain_total(f, x, c, reps), c1, c2
@@ -161,6 +168,7 @@ def _emit_slope(name: str, n_items: int, unit: str, sort_fn, x, c1, c2, reps,
         name, n_items / per, unit, baseline=baseline,
         **_slope_fields(per, fixed, chained, n_items, c1, c2), **extra,
     )
+    return f, per, fixed, chained
 
 
 def main() -> None:
@@ -212,9 +220,11 @@ def main() -> None:
         return
 
     # The round-1 headline kernel (XLA lax.sort) on the same workload, for a
-    # like-for-like speedup record in the same artifact.
+    # like-for-like speedup record in the same artifact.  The runner is kept
+    # and re-measured at suite end as the tunnel-drift sensor below.
+    hbm_sensor = None
     if kernel != "lax":
-        _emit_slope(
+        hbm_sensor = _emit_slope(
             f"sort_throughput_int32_{n}_keys_single_chip_{chip}_lax_kernel",
             n, "keys/sec",
             lambda v: sort_with_kernel(v, "lax"), x, c_short, chain, reps,
@@ -471,6 +481,34 @@ print(json.dumps({
             k: round(v, 4) for k, v in sorted(m.phase_s.items())
         },
     )
+
+    # Tunnel/HBM drift sentinel: lax.sort is HBM-pass-bound and swings ~2x
+    # with relay health (the VMEM-resident block kernel held within ~1%
+    # through the same swings), so re-measuring the SAME lax chain that
+    # opened the suite flags whether later lines were taken in a degraded
+    # window (observed r4: one window measured every chained program
+    # 20-30x slow).  slowdown_at_end > ~1.5 means read the lines between
+    # with suspicion; ~1.0 means the artifact is one coherent session.
+    if hbm_sensor is not None and chip == "tpu":
+        f_lax, per0, fixed0, chained0 = hbm_sensor
+        per1, fixed1, chained1 = _slope_of(
+            lambda c: _chain_total(f_lax, x, c, reps), c_short, chain
+        )
+        # Compare like with like: slope-vs-slope when both slopes are
+        # valid, else chained-vs-chained (the fallback fires exactly in
+        # the degraded windows this sensor exists to flag, and a chained
+        # figure still carries overhead/c2 the slope cancels).
+        if fixed0 is not None and fixed1 is not None:
+            slowdown = per1 / per0
+        else:
+            slowdown = chained1 / chained0
+        _emit(
+            "tunnel_drift_sensor_lax_int32", n / per1, "keys/sec",
+            baseline=False,
+            **_slope_fields(per1, fixed1, chained1, n, c_short, chain),
+            start_of_suite_keys_per_sec=round(n / per0, 1),
+            slowdown_at_end=round(slowdown, 3),
+        )
 
 
 if __name__ == "__main__":
