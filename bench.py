@@ -301,13 +301,15 @@ def main() -> None:
                 0, c, lambda i, cr: kv_local(cr, i), (k, s, v)
             )
         )
-        np.asarray(fkv(kq, sq, vq, 2)[2][-1:, -1:])  # warm + materialize
+        # np.int32 chain length: see _chain_runner (pins the aval across
+        # the x64 flip; bare ints are weak scalars and would recompile).
+        np.asarray(fkv(kq, sq, vq, np.int32(2))[2][-1:, -1:])  # warm
 
         def _kv_chain_total(c: int) -> float:
             times = []
             for _ in range(reps):
                 t0 = time.perf_counter()
-                r = fkv(kq, sq, vq, c)
+                r = fkv(kq, sq, vq, np.int32(c))
                 np.asarray(r[2][-1:, -1:])  # completion barrier
                 times.append(time.perf_counter() - t0)
             return float(min(times))
@@ -353,14 +355,15 @@ def main() -> None:
                     a,
                 )
             )
-            np.asarray(f(runs, 2)[-1:, -1:])  # warm + materialize
+            # np.int32: see _chain_runner (pin the aval across the x64 flip)
+            np.asarray(f(runs, np.int32(2))[-1:, -1:])  # warm + materialize
             return f
 
         def _rows_chain_total(f, c: int) -> float:
             times = []
             for _ in range(reps):
                 t0 = time.perf_counter()
-                np.asarray(f(runs, c)[-1:, -1:])
+                np.asarray(f(runs, np.int32(c))[-1:, -1:])
                 times.append(time.perf_counter() - t0)
             return float(min(times))
 

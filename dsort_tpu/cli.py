@@ -30,6 +30,32 @@ from dsort_tpu.config import SortConfig
 from dsort_tpu.utils.logging import get_logger
 from dsort_tpu.utils.metrics import Metrics
 
+# Wedged-on-first-contact latch for the fused small-job path (ADVICE r4).
+# The discriminator is the fused LANE, not lapse counts: all fused
+# attempts serialize on one lane thread, so "one entry executing for
+# longer than any cold compile ever observed" is direct evidence the chip
+# is wedged, while any number of cold lapses QUEUED behind a
+# still-compiling entry is not.  The ceiling sits 1.5x above the slowest
+# cold compile seen through the axon remote Mosaic service (~10 min for
+# one K2a shape, r3).
+FUSED_COLD_WEDGE_CEILING_S = 900.0
+# A cold latch is EVIDENCE, not proof — a pathological compile can outlast
+# even the ceiling (the remote service swings ~8x between sessions).  So
+# unlike the warm-wedge latch (the executable had run before; the stuck
+# lane is proof), the cold latch expires: after this long the path retries
+# — if the stall was a compile it has drained and the retry succeeds fast;
+# a truly wedged chip lapses again with the lane stuck even longer and
+# re-latches on that single lapse.  Worst case on a wedged chip: one cold
+# wait budget per interval.
+FUSED_COLD_RETRY_S = 1800.0
+# Backstop for FAIL-SLOW devices the lane discriminator cannot see (each
+# fused call errors after the wait budget but before the ceiling, so the
+# lane keeps draining): this many consecutive cold lapses without a single
+# fused success latch the path off too.  A false trip during one slow
+# compile with many queued jobs is benign — the latch expires and the
+# post-drain retry succeeds and resets.
+FUSED_COLD_LAPSE_BACKSTOP = 8
+
 log = get_logger("cli")
 
 
@@ -103,6 +129,21 @@ def _make_sorter(cfg: SortConfig, mode: str):
         # process lifetime and the lane key never changes — skip the fused
         # path from then on instead of paying a full wait budget per job.
         fused_wedged = threading.Event()
+        # A chip genuinely wedged on FIRST contact never warms the fused
+        # (lane,size) bucket, so every lapse stays "cold" and the
+        # compile-grace exemption below would retry forever (ADVICE r4).
+        # Bound it with the lane-stuck discriminator (see the module
+        # constants): once the fused lane has been inside ONE entry for
+        # longer than any compile ever observed, latch the path off until
+        # the retry interval expires.
+        fused_cold_latch_ts = [0.0]  # 0 = cold latch inactive
+        fused_cold_streak = [0]  # consecutive cold lapses since a success
+
+        def fused_path_open() -> bool:
+            if fused_wedged.is_set():
+                return False  # warm wedge: permanent (stuck proven lane)
+            ts = fused_cold_latch_ts[0]
+            return not ts or time.monotonic() - ts > FUSED_COLD_RETRY_S
 
         def sorter(data, metrics, job_id=None):
             # Small jobs skip the SPMD driver: one fused device program is
@@ -117,7 +158,7 @@ def _make_sorter(cfg: SortConfig, mode: str):
             if (
                 len(data) < FUSED_SMALL_JOB_MAX
                 and not checkpointing
-                and not fused_wedged.is_set()
+                and fused_path_open()
             ):
                 try:
                     # run_bounded: the fused program's block_until_ready is
@@ -131,6 +172,8 @@ def _make_sorter(cfg: SortConfig, mode: str):
                         n_keys=len(data), tag="fused",
                     )
                     metrics.bump("fused_small_jobs")
+                    fused_cold_latch_ts[0] = 0.0
+                    fused_cold_streak[0] = 0
                     return out
                 except Exception as e:
                     from dsort_tpu.scheduler.fault import (
@@ -152,6 +195,37 @@ def _make_sorter(cfg: SortConfig, mode: str):
                         # the compile continues on its lane, warms the jit
                         # cache, and the next small job tries fused again.
                         fused_wedged.set()
+                    elif isinstance(e, ProgramWaitTimeout):
+                        stuck = sched.lane_stuck_for("fused")
+                        # The streak resets ONLY on a fused success: a
+                        # sustained fail-slow device re-latches on the
+                        # single post-expiry retry lapse (streak still at
+                        # the backstop), matching the wedged-chip path's
+                        # one-budget-per-interval worst case.  Wedged-chip
+                        # diagnosis (lane stuck) is checked first so the
+                        # log names the right failure mode.
+                        fused_cold_streak[0] += 1
+                        if stuck > FUSED_COLD_WEDGE_CEILING_S:
+                            log.warning(
+                                "fused path latched off for %.0f s: the "
+                                "fused lane has been inside one entry for "
+                                "%.0f s (past the %.0f s compile ceiling "
+                                "— chip wedged on first contact, not "
+                                "compiling)", FUSED_COLD_RETRY_S, stuck,
+                                FUSED_COLD_WEDGE_CEILING_S,
+                            )
+                            fused_cold_latch_ts[0] = time.monotonic()
+                        elif (
+                            fused_cold_streak[0]
+                            >= FUSED_COLD_LAPSE_BACKSTOP
+                        ):
+                            log.warning(
+                                "fused path latched off for %.0f s: %d "
+                                "consecutive cold wait lapses without a "
+                                "fused success (fail-slow device backstop)",
+                                FUSED_COLD_RETRY_S, fused_cold_streak[0],
+                            )
+                            fused_cold_latch_ts[0] = time.monotonic()
                     metrics.bump("fused_fallbacks")
                     log.warning(
                         "fused small-job path failed (%s); retrying on the "

@@ -34,7 +34,7 @@ a merge-block boundary.  The pass structure:
   `_cross_stages`), so wide keys keep per-stage crosses.
 - **K2 (cross stage)**: per-stage pass for multi-plane keys, and the
   fallback for distances whose orbit would exceed the VMEM cap
-  (``ORBIT_MID_MAX``; first reached at 2^28): each grid step owns a whole
+  (``ORBIT_MID_MAX``; first reached at 2^27 int32): each grid step owns a
   pair via a ``(pairs, 2, m, rows, 128)`` view (one strided rectangular
   DMA per side) and writes both members — 2n bytes per stage.
 - **K2b (multi-cross)**: distances ``2..MULTI_M_HI`` blocks fuse into ONE
@@ -721,7 +721,9 @@ def _orbit(xs, rows: int, mid: int, stride: int, kb_shift: int, interpret: bool)
 # VMEM cap on the orbit's mid axis (blocks per slab, single-plane): slabs
 # pipeline as in+out x double-buffer, so 32 x 512 KiB x 4 = 64 MiB at the
 # defaults.  Levels wider than the cap peel their top stages as K2 singles
-# (first reached at 2^28 int32 / 2^27 int64 at default block_rows).
+# (first reached at 2^27 int32 at default block_rows: 1024 blocks put the
+# top level's mid=64 over the cap of 32; multi-plane keys never take the
+# orbit path at all — ``orbit_cap=0`` in ``_cross_stages``).
 ORBIT_MID_MAX = 32
 
 

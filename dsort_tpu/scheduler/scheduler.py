@@ -124,6 +124,7 @@ class _AttemptLane:
         import queue
 
         self._q: "queue.Queue" = queue.Queue()
+        self._busy_since = 0.0  # monotonic start of the RUNNING entry; 0=idle
         threading.Thread(target=self._loop, daemon=True, name=name).start()
 
     def _loop(self) -> None:
@@ -136,12 +137,26 @@ class _AttemptLane:
                 # long since reassigned and completed.
                 done.set()
                 continue
+            self._busy_since = time.monotonic()
             try:
                 box["r"] = fn()
             except BaseException as e:  # surfaced by the waiter
                 box["e"] = e
             finally:
+                self._busy_since = 0.0
                 done.set()
+
+    def stuck_for(self) -> float:
+        """Seconds the CURRENT entry has been executing (0.0 when idle).
+
+        The wedge-vs-slow-compile discriminator (ADVICE r4): a wedged
+        device call never returns, so this grows without bound; a slow
+        cold compile returns within the service's worst case.  Single
+        writer (the lane thread), racing readers see either 0.0 or a
+        valid start stamp — both safe.
+        """
+        t0 = self._busy_since
+        return time.monotonic() - t0 if t0 else 0.0
 
     def submit(self, fn):
         box: dict = {}
@@ -464,6 +479,19 @@ class SpmdScheduler:
                     f"prog-{key[0]}-{len(self._mesh_lanes)}"
                 )
             return lane
+
+    def lane_stuck_for(self, tag: str = "prog") -> float:
+        """Seconds ``tag``'s mesh lane has been inside its CURRENT entry
+        (0.0 when idle or never used).  The wedge-vs-slow-compile
+        discriminator for `run_bounded` callers: attempts serialize per
+        lane, so one entry executing past the worst observed cold-compile
+        time means the device call is wedged, while lapses merely QUEUED
+        behind a still-compiling entry do not (see the fused small-job
+        latch in cli)."""
+        key = (tag,) + tuple(d.id for d in self.devices)
+        with self._mesh_lanes_lock:
+            lane = self._mesh_lanes.get(key)
+        return lane.stuck_for() if lane is not None else 0.0
 
     def _live_devices(self) -> list[jax.Device]:
         return [self.devices[i] for i in self.table.live_workers()]

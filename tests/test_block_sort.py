@@ -149,7 +149,7 @@ def test_orbit_pass_uint32_sign_flip_path():
 
 def test_orbit_cap_peels_k2_singles(monkeypatch):
     """With ORBIT_MID_MAX forced to 2, wide levels peel their top cross
-    stages as K2 singles before the capped orbit — the >=2^28 fallback path
+    stages as K2 singles before the capped orbit — the >=2^27-int32 fallback
     exercised at test scale.  kb_shift > 0 directions are what this pins."""
     import dsort_tpu.ops.block_sort as B
 

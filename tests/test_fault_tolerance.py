@@ -880,6 +880,120 @@ def test_fused_cold_lapse_does_not_latch(monkeypatch, mesh8):
     assert m2.counters.get("fused_small_jobs") == 1
 
 
+def test_fused_repeated_cold_lapses_latch(monkeypatch, mesh8):
+    """A chip wedged on FIRST contact never warms the fused bucket, so every
+    lapse stays cold and the single-lapse compile-grace exemption would
+    retry forever (ADVICE r4).  The wedge discriminator is the fused LANE:
+    one entry executing past the compile ceiling latches the path off —
+    cold lapses alone (queued behind a possibly-still-compiling entry)
+    never do, no matter how many."""
+    import time as _time
+
+    import dsort_tpu.models.pipelines as pmod
+    from dsort_tpu import cli
+    from dsort_tpu.config import SortConfig
+
+    calls = {"n": 0}
+
+    def wedge(data, kernel="auto", metrics=None):
+        calls["n"] += 1
+        _time.sleep(120.0)  # wedged from the very first contact
+
+    monkeypatch.setattr(pmod, "fused_sort_small", wedge)
+    cfg = SortConfig(job=HANG_FAST)
+    sorter = cli._make_sorter(cfg, "spmd")
+    data = gen_uniform(10_000, seed=98)
+    # Leg 1 — lapses alone never latch: with the ceiling out of reach,
+    # both jobs pay a cold lapse and fall back, and the path stays open
+    # (this is the legitimately-slow-compile tolerance).
+    monkeypatch.setattr(cli, "FUSED_COLD_WEDGE_CEILING_S", 1e9)
+    for _ in range(2):
+        m = Metrics()
+        out = sorter(data, m)
+        np.testing.assert_array_equal(out, np.sort(data))
+        assert m.counters["fused_fallbacks"] == 1
+    assert calls["n"] == 1  # the 2nd attempt queued behind the stuck lane
+    # Leg 2 — the lane has now been inside ONE entry longer than this
+    # ceiling: the next lapse reads that and latches.
+    monkeypatch.setattr(cli, "FUSED_COLD_WEDGE_CEILING_S", 2.0)
+    m3 = Metrics()
+    out3 = sorter(data, m3)
+    np.testing.assert_array_equal(out3, np.sort(data))
+    assert m3.counters["fused_fallbacks"] == 1
+    t0 = _time.monotonic()
+    m4 = Metrics()
+    out4 = sorter(data, m4)  # latched: no fused attempt, no wait
+    np.testing.assert_array_equal(out4, np.sort(data))
+    assert "fused_fallbacks" not in m4.counters
+    assert _time.monotonic() - t0 < 2.0  # went straight to the scheduler
+    # Leg 3 — the cold latch is evidence, not proof: it EXPIRES, and the
+    # post-expiry retry either clears it (compile drained) or — as here,
+    # lane still stuck — re-latches on that single lapse.
+    monkeypatch.setattr(cli, "FUSED_COLD_RETRY_S", 0.3)
+    _time.sleep(0.4)
+    mr = Metrics()
+    out_r = sorter(data, mr)  # retry attempt lapses cold -> re-latch
+    np.testing.assert_array_equal(out_r, np.sort(data))
+    assert mr.counters["fused_fallbacks"] == 1
+    # Restore a long retry interval so the fresh re-latch cannot expire
+    # between here and the final call.
+    monkeypatch.setattr(cli, "FUSED_COLD_RETRY_S", 1800.0)
+    t1 = _time.monotonic()
+    mf = Metrics()
+    out_f = sorter(data, mf)  # re-latched: closed again, no wait
+    np.testing.assert_array_equal(out_f, np.sort(data))
+    assert "fused_fallbacks" not in mf.counters
+    assert _time.monotonic() - t1 < 2.0
+
+
+def test_fused_fail_slow_backstop_latches(monkeypatch, mesh8):
+    """A FAIL-SLOW device (each fused call errors after the wait budget but
+    before the wedge ceiling) keeps the lane draining, so the lane-stuck
+    discriminator never fires — the consecutive-cold-lapse backstop must
+    latch the path off instead of letting every job pay a full budget."""
+    import time as _time
+
+    import dsort_tpu.models.pipelines as pmod
+    from dsort_tpu import cli
+    from dsort_tpu.config import SortConfig
+
+    def fail_slow(data, kernel="auto", metrics=None):
+        _time.sleep(4.0)  # outlasts the ~2.6 s cold budget, then drains
+
+    monkeypatch.setattr(pmod, "fused_sort_small", fail_slow)
+    monkeypatch.setattr(cli, "FUSED_COLD_LAPSE_BACKSTOP", 3)
+    cfg = SortConfig(job=HANG_FAST)
+    sorter = cli._make_sorter(cfg, "spmd")
+    data = gen_uniform(10_000, seed=99)
+    for _ in range(3):  # each lapses cold; the lane drains between jobs
+        m = Metrics()
+        out = sorter(data, m)
+        np.testing.assert_array_equal(out, np.sort(data))
+        assert m.counters["fused_fallbacks"] == 1
+    t0 = _time.monotonic()
+    mf = Metrics()
+    out_f = sorter(data, mf)  # backstop latched: no attempt, no wait
+    np.testing.assert_array_equal(out_f, np.sort(data))
+    assert "fused_fallbacks" not in mf.counters
+    assert _time.monotonic() - t0 < 2.0
+    # The streak resets only on a fused SUCCESS, so the post-expiry retry
+    # lapse re-latches immediately (streak still at the backstop) — one
+    # budget per interval, not another full backstop run.
+    monkeypatch.setattr(cli, "FUSED_COLD_RETRY_S", 0.3)
+    _time.sleep(0.4)
+    mr = Metrics()
+    out_r = sorter(data, mr)
+    np.testing.assert_array_equal(out_r, np.sort(data))
+    assert mr.counters["fused_fallbacks"] == 1
+    monkeypatch.setattr(cli, "FUSED_COLD_RETRY_S", 1800.0)
+    t1 = _time.monotonic()
+    m2 = Metrics()
+    out2 = sorter(data, m2)  # re-latched on that single lapse
+    np.testing.assert_array_equal(out2, np.sort(data))
+    assert "fused_fallbacks" not in m2.counters
+    assert _time.monotonic() - t1 < 2.0
+
+
 def test_taskpool_genuine_timeout_inside_attempt_propagates(monkeypatch):
     """A TimeoutError raised INSIDE a shard attempt (e.g. IO on a network
     mount) is not a lapsed heartbeat wait: it surfaces instead of silently
