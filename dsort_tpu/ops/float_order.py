@@ -86,6 +86,23 @@ def sort_float_key_batch_via_uint(sort_fn, jobs, *args, **kwargs):
     return [ordered_uint_to_float(o, fdt) for o in outs]
 
 
+def sort_float_kv_batch_via_uint(sort_fn, pairs, *args, **kwargs):
+    """Batched kv form: a LIST of ``(float_keys, payload)`` pairs.
+
+    Keys map through the bijection, payloads ride unchanged (they follow
+    their mapped keys through the shuffle exactly as through the original
+    floats — the mapping is order-preserving).  ``sort_fn(mapped_pairs,
+    *args, **kwargs)`` returns the list of (sorted_keys, payload) tuples.
+    Same single-boundary rule: batch kv drivers go through here.
+    """
+    fdt = np.asarray(pairs[0][0]).dtype
+    outs = sort_fn(
+        [(float_to_ordered_uint(np.asarray(k)), v) for k, v in pairs],
+        *args, **kwargs,
+    )
+    return [(ordered_uint_to_float(k, fdt), v) for k, v in outs]
+
+
 def sort_float_keys_via_uint(sort_fn, keys: np.ndarray, *args, **kwargs):
     """Run a key sort through the bijection: map, sort as uints, unmap.
 

@@ -480,6 +480,11 @@ class SpmdScheduler:
                 )
             return lane
 
+    def _lane_key(self, tag: str) -> tuple:
+        """The default mesh-lane key for ``tag`` — shared by `run_bounded`
+        and `lane_stuck_for` so the two can never drift apart."""
+        return (tag,) + tuple(d.id for d in self.devices)
+
     def lane_stuck_for(self, tag: str = "prog") -> float:
         """Seconds ``tag``'s mesh lane has been inside its CURRENT entry
         (0.0 when idle or never used).  The wedge-vs-slow-compile
@@ -488,9 +493,8 @@ class SpmdScheduler:
         time means the device call is wedged, while lapses merely QUEUED
         behind a still-compiling entry do not (see the fused small-job
         latch in cli)."""
-        key = (tag,) + tuple(d.id for d in self.devices)
         with self._mesh_lanes_lock:
-            lane = self._mesh_lanes.get(key)
+            lane = self._mesh_lanes.get(self._lane_key(tag))
         return lane.stuck_for() if lane is not None else 0.0
 
     def _live_devices(self) -> list[jax.Device]:
@@ -626,6 +630,20 @@ class SpmdScheduler:
             )
         outs = ss.sort_ranges(work, metrics)
         self._check_cancelled(cancelled)
+        # Fresh sort: the range views share ONE backing buffer already laid
+        # out in global order — return it instead of re-concatenating (the
+        # restore paths above genuinely merge ranges loaded from disk).
+        # Recovered from the views (not _sort_ranges_impl) so wrappers
+        # around sort_ranges — fault drills monkeypatch it — stay honored.
+        base = outs[0].base if outs else None
+        if (
+            base is not None
+            and all(o.base is base for o in outs)
+            and len(base) == len(work)
+        ):
+            buf = base
+        else:
+            buf = np.concatenate(outs)
         # Drop leftover range files before recording the fresh layout: an
         # abandoned attempt (or torn earlier run) may have persisted ranges
         # under a DIFFERENT mesh size whose ids would otherwise mix with
@@ -645,7 +663,7 @@ class SpmdScheduler:
                 self.injector.check(live[min(i, len(live) - 1)], "assemble")
             self._check_cancelled(cancelled)
             ckpt.save_range(i, r)
-        return np.concatenate(outs)
+        return buf
 
     def _resume_missing_ranges(
         self, work: np.ndarray, ckpt, ss, done: list[int], metrics: Metrics,
@@ -758,9 +776,7 @@ class SpmdScheduler:
         and ~8 min another) delays the job instead of failing it, while a
         genuinely wedged chip still fails its probe on the first lapse.
         """
-        key = lane_key if lane_key is not None else (
-            (tag,) + tuple(d.id for d in self.devices)
-        )
+        key = lane_key if lane_key is not None else self._lane_key(tag)
         warm = (key, _size_bucket(n_keys))
         budget = boost * self._wait_budget(n_keys, warm in self._warm_waits)
         box, done, abandoned = self._mesh_lane(key).submit(fn)

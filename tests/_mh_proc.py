@@ -53,6 +53,63 @@ def main() -> None:
 
     from dsort_tpu.parallel.distributed import sort_local_shards
 
+    if dtype == "ckpt":
+        # Recoverable-job mode: ONE deterministic global dataset split
+        # evenly over however many processes this run has (the
+        # partition-independent fingerprint must accept a 2-process job
+        # restarting as 1 process), persisted ranges under the shared
+        # checkpoint dir from DSORT_MH_CKPT_DIR.
+        from dsort_tpu.config import JobConfig
+        from dsort_tpu.data.partition import equal_partition
+        from dsort_tpu.utils.metrics import Metrics
+
+        all_data = (
+            np.random.default_rng(777)
+            .integers(-(10**6), 10**6, 9000)
+            .astype(np.int32)
+        )
+        if os.environ.get("DSORT_MH_FLIP_KEY"):
+            all_data[0] ^= 1  # staleness drill: same job_id, changed data
+        sizes = equal_partition(len(all_data), nprocs)
+        start = int(np.sum(sizes[:pid]))
+        data = all_data[start : start + sizes[pid]]
+        job = JobConfig(checkpoint_dir=os.environ["DSORT_MH_CKPT_DIR"])
+        m = Metrics()
+        out, off = sort_local_shards(data, job=job, metrics=m, job_id="mhjob")
+        np.save(os.path.join(outdir, f"out_{pid}.npy"), out)
+        with open(os.path.join(outdir, f"meta_{pid}.json"), "w") as f:
+            json.dump({"offset": off, "counters": dict(m.counters)}, f)
+        return
+
+    if dtype == "ckpt_kv":
+        # Recoverable record-job mode: deterministic global TeraSort
+        # records split over the current process count.
+        from dsort_tpu.config import JobConfig
+        from dsort_tpu.data.ingest import gen_terasort, terasort_secondary
+        from dsort_tpu.data.partition import equal_partition
+        from dsort_tpu.parallel.distributed import sort_local_records
+        from dsort_tpu.utils.metrics import Metrics
+
+        all_k, all_v = gen_terasort(3000, seed=777)
+        sizes = equal_partition(len(all_k), nprocs)
+        start = int(np.sum(sizes[:pid]))
+        k = all_k[start : start + sizes[pid]]
+        v = all_v[start : start + sizes[pid]]
+        job = JobConfig(
+            key_dtype=np.uint64, payload_bytes=v.shape[1],
+            checkpoint_dir=os.environ["DSORT_MH_CKPT_DIR"],
+        )
+        m = Metrics()
+        out_k, out_v, off = sort_local_records(
+            k, v, secondary=terasort_secondary(v), job=job, metrics=m,
+            job_id="mhkv",
+        )
+        np.save(os.path.join(outdir, f"out_{pid}.npy"), out_k)
+        np.save(os.path.join(outdir, f"outv_{pid}.npy"), out_v)
+        with open(os.path.join(outdir, f"meta_{pid}.json"), "w") as f:
+            json.dump({"offset": off, "counters": dict(m.counters)}, f)
+        return
+
     if dtype == "float32nan":
         data = rng.normal(size=n).astype(np.float32)
         data[::97] = np.nan

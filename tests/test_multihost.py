@@ -28,11 +28,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_cluster(tmp_path, dtype: str, nprocs: int = 2) -> None:
+def _run_cluster(
+    tmp_path, dtype: str, nprocs: int = 2, env_extra: dict | None = None,
+    expect_rc: dict | None = None,
+) -> None:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
     port = _free_port()
     procs = [
         subprocess.Popen(
@@ -45,9 +49,15 @@ def _run_cluster(tmp_path, dtype: str, nprocs: int = 2) -> None:
         for pid in range(nprocs)
     ]
     try:
-        for p in procs:
+        for pid, p in enumerate(procs):
             out, err = p.communicate(timeout=300)
-            assert p.returncode == 0, err.decode()[-2000:]
+            want = (expect_rc or {}).get(pid, 0)
+            if want == "any":  # crash drills: survivors also fail at the
+                continue  # collective/shutdown barrier once a host is gone
+            assert p.returncode == want, (
+                f"proc {pid}: rc {p.returncode} != {want}\n"
+                + err.decode()[-2000:]
+            )
     finally:  # a hung cluster must not leak live jax processes into CI
         for p in procs:
             if p.poll() is None:
@@ -124,3 +134,131 @@ def test_two_process_cluster_float32_nan(tmp_path):
         assert np.isnan(got[k:]).all()
 
     _check(tmp_path, check)
+
+
+def _mh_global_data() -> np.ndarray:
+    """The deterministic global dataset of _mh_proc's 'ckpt' mode."""
+    return (
+        np.random.default_rng(777)
+        .integers(-(10**6), 10**6, 9000)
+        .astype(np.int32)
+    )
+
+
+def _ckpt_outputs(rundir, nprocs):
+    outs = [np.load(rundir / f"out_{i}.npy") for i in range(nprocs)]
+    metas = [
+        json.load(open(rundir / f"meta_{i}.json")) for i in range(nprocs)
+    ]
+    got = np.concatenate(outs)
+    off = 0
+    for o, meta in zip(outs, metas):
+        assert meta["offset"] == off
+        off += len(o)
+    return got, metas
+
+
+def test_multihost_checkpoint_crash_resume(tmp_path):
+    """The pod-scale recovery story (VERDICT r4 missing #1): a 2-process job
+    loses a host mid-persist; re-running the SAME job_id — even with a
+    DIFFERENT process count — restores the surviving host's range and
+    re-sorts only the missing key interval, then a further run restores
+    fully.  jax.distributed cannot re-form live, so the model is
+    restart-and-resume (ARCHITECTURE 'multi-host')."""
+    ck = tmp_path / "ck"
+    expect = np.sort(_mh_global_data())
+    env = {"DSORT_MH_CKPT_DIR": str(ck)}
+
+    # Run 1: process 1 dies between the collective and its range persist —
+    # exactly the mid-job host loss state (range_0 persisted, range_1 not).
+    # The survivor persists its range but then fails at the cluster's
+    # shutdown barrier (jax.distributed cannot outlive a dead host) — that
+    # collapse IS the failure mode the recovery model exists for.
+    r1 = tmp_path / "run1"
+    r1.mkdir()
+    _run_cluster(
+        r1, "ckpt", nprocs=2,
+        env_extra={**env, "DSORT_MH_DIE_BEFORE_RANGE": "1"},
+        expect_rc={0: "any", 1: 17},
+    )
+    assert (ck / "mhjob" / "range_00000.npy").exists()
+    assert not (ck / "mhjob" / "range_00001.npy").exists()
+
+    # Run 2: restart with ONE process over the same global data: restores
+    # range 0, re-sorts only the missing interval, output exact.
+    r2 = tmp_path / "run2"
+    r2.mkdir()
+    _run_cluster(r2, "ckpt", nprocs=1, env_extra=env)
+    got, metas = _ckpt_outputs(r2, 1)
+    np.testing.assert_array_equal(got, expect)
+    c = metas[0]["counters"]
+    assert c.get("multihost_ranges_restored") == 1
+    assert 0 < c.get("multihost_resort_keys", 0) < len(expect)
+
+    # Run 3: back to 2 processes — the rewritten checkpoint fully restores
+    # (no re-sort at all), slices stitch to the same exact output.
+    r3 = tmp_path / "run3"
+    r3.mkdir()
+    _run_cluster(r3, "ckpt", nprocs=2, env_extra=env)
+    got3, metas3 = _ckpt_outputs(r3, 2)
+    np.testing.assert_array_equal(got3, expect)
+    for meta in metas3:
+        assert meta["counters"].get("multihost_ranges_restored", 0) >= 1
+        assert "multihost_resort_keys" not in meta["counters"]
+
+
+def test_multihost_checkpoint_stale_data_clears(tmp_path):
+    """A job_id resumed against DIFFERENT global data must not serve stale
+    ranges: the partition-independent fingerprint mismatches and the job
+    re-sorts from scratch (the single-host staleness guard, pod-scale)."""
+    ck = tmp_path / "ck"
+    env = {"DSORT_MH_CKPT_DIR": str(ck)}
+    r1 = tmp_path / "run1"
+    r1.mkdir()
+    _run_cluster(r1, "ckpt", nprocs=2, env_extra=env)
+    got, _ = _ckpt_outputs(r1, 2)
+    np.testing.assert_array_equal(got, np.sort(_mh_global_data()))
+    # Same job_id, different data (the drill flips one element via env) —
+    # must NOT restore.
+    r2 = tmp_path / "run2"
+    r2.mkdir()
+    _run_cluster(
+        r2, "ckpt", nprocs=2, env_extra={**env, "DSORT_MH_FLIP_KEY": "1"},
+    )
+    flipped = _mh_global_data()
+    flipped[0] ^= 1
+    got2, metas2 = _ckpt_outputs(r2, 2)
+    np.testing.assert_array_equal(got2, np.sort(flipped))
+    for meta in metas2:
+        assert "multihost_ranges_restored" not in meta["counters"]
+
+
+def test_multihost_kv_checkpoint_restore(tmp_path):
+    """Record (TeraSort) jobs persist per-host (keys range, payload block)
+    pairs; a restart — here with a different process count — restores the
+    complete checkpoint instead of re-shuffling 92 B payloads."""
+    from dsort_tpu.data.ingest import gen_terasort, terasort_secondary
+
+    ck = tmp_path / "ck"
+    env = {"DSORT_MH_CKPT_DIR": str(ck)}
+    all_k, all_v = gen_terasort(3000, seed=777)
+    order = np.lexsort((terasort_secondary(all_v), all_k))
+
+    r1 = tmp_path / "run1"
+    r1.mkdir()
+    _run_cluster(r1, "ckpt_kv", nprocs=2, env_extra=env)
+    got_k = np.concatenate(
+        [np.load(r1 / f"out_{i}.npy") for i in range(2)]
+    )
+    np.testing.assert_array_equal(got_k, all_k[order])
+
+    r2 = tmp_path / "run2"
+    r2.mkdir()
+    _run_cluster(r2, "ckpt_kv", nprocs=1, env_extra=env)
+    got_k2 = np.load(r2 / "out_0.npy")
+    got_v2 = np.load(r2 / "outv_0.npy")
+    meta = json.load(open(r2 / "meta_0.json"))
+    np.testing.assert_array_equal(got_k2, all_k[order])
+    np.testing.assert_array_equal(got_v2, all_v[order])
+    assert meta["counters"].get("multihost_ranges_restored") == 2
+    assert meta["offset"] == 0

@@ -446,13 +446,33 @@ def test_batch_float_jobs_checkpoint_resume(devices, tmp_path):
         np.testing.assert_array_equal(o1, np.sort(j))  # NaNs last, np-style
 
 
-def test_batch_kv_rejects_float_keys(devices):
+def test_batch_kv_float_nan_payloads(devices):
+    """Float-keyed batched records ride the ordered-uint mapping like every
+    other driver (VERDICT r4 weak #5): payloads follow their keys, NaN-keyed
+    records come back LAST with payloads attached, keys canonicalized."""
+    from dsort_tpu.ops.float_order import float_to_ordered_uint
     from dsort_tpu.parallel.sample_sort import BatchSampleSort
 
     mesh = _mesh_dp2(devices)
-    pairs = [(np.zeros(8, np.float32), np.zeros((8, 2), np.uint8))]
-    with pytest.raises(TypeError, match="integer keys"):
-        BatchSampleSort(mesh).sort_kv(pairs)
+    rng = np.random.default_rng(81)
+    pairs = []
+    for n in (2_000, 700):
+        k = rng.normal(size=n).astype(np.float32)
+        k[::37] = np.nan
+        v = rng.integers(0, 255, (n, 3)).astype(np.uint8)
+        pairs.append((k, v))
+    outs = BatchSampleSort(mesh).sort_kv(pairs)
+    for (k, v), (sk, sv) in zip(pairs, outs):
+        valid = len(k) - int(np.isnan(k).sum())
+        np.testing.assert_array_equal(sk[:valid], np.sort(k)[:valid])
+        assert np.isnan(sk[valid:]).all()
+        # Key-payload association, NaN-safe: compare multisets under the
+        # order-preserving bijection (canonicalizes every NaN one way).
+        ku, sku = float_to_ordered_uint(k), float_to_ordered_uint(sk)
+        assert (np.diff(sku.astype(np.int64)) >= 0).all()
+        assert sorted(zip(ku.tolist(), map(bytes, v))) == sorted(
+            zip(sku.tolist(), map(bytes, sv))
+        )
 
 
 def test_batch_kv_mixed_payload_shapes_bucketed(devices):
