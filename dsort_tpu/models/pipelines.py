@@ -91,10 +91,13 @@ def fused_sort_small(
     with timer.phase("partition"):
         buf = np.empty(n_pad, data.dtype)
         buf[:n] = data  # tail garbage is sentinel-masked on device
-        x = jnp.asarray(buf)
     with timer.phase("local_sort"):
-        out = _fused_small_fn(n_pad, str(data.dtype), kernel)(x, np.int32(n))
-        out.block_until_ready()
+        # ONE dispatch end-to-end (VERDICT r4 next #6): the padded host
+        # array feeds the jitted program directly — no jnp.asarray staging
+        # round trip — and no block_until_ready: the result fetch below is
+        # the completion barrier (a separate sync costs a full relay round
+        # trip, comparable to the whole job at this size).
+        out = _fused_small_fn(n_pad, str(data.dtype), kernel)(buf, np.int32(n))
     with timer.phase("assemble"):
         return np.asarray(out)[:n]
 
