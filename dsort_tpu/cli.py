@@ -161,10 +161,11 @@ def _make_sorter(cfg: SortConfig, mode: str):
                 and fused_path_open()
             ):
                 try:
-                    # run_bounded: the fused program's block_until_ready is
-                    # covered by the same in-flight hang detection as the
-                    # SPMD collective (VERDICT r3 #1) — a wedged chip makes
-                    # this time out and fall back, never block forever.
+                    # run_bounded: the fused program's completion barrier
+                    # (the result fetch inside fused_sort_small) is covered
+                    # by the same in-flight hang detection as the SPMD
+                    # collective (VERDICT r3 #1) — a wedged chip makes this
+                    # time out and fall back, never block forever.
                     out = sched.run_bounded(
                         lambda: fused_sort_small(
                             data, cfg.job.local_kernel, metrics
