@@ -98,10 +98,14 @@ tunnel state swings ~15% between sessions, so treat per-pass rows as
                                   ignored the stage MIX: 36% of K2a's
                                   stages are ~2.6x-cost lane stages vs
                                   K1's 18%.  Measured/bound = 0.91-1.04
-                                  — nothing left to cut without a
-                                  cheaper lane-exchange formulation,
-                                  which the microbench table below
-                                  already searched.
+                                  (r4 set) and 1.10 in the r5
+                                  confirmation session (K2a 2.04 ms
+                                  against a full-kernel anchor of 8.49
+                                  vs r4's 8.36) — at bound within the
+                                  session swing; nothing left to cut
+                                  without a cheaper lane-exchange
+                                  formulation, which the microbench
+                                  table below already searched.
   full kernel           7.6-8.3   slope, session-dependent (the A/B
                                   session read 8.33 with / 8.77 without
                                   the orbit; an earlier same-day session
