@@ -69,6 +69,73 @@ def test_shard_checkpoint_roundtrip(tmp_path):
     assert ck.completed_shards() == []
 
 
+def test_shard_checkpoint_namespaces_clear_independently(tmp_path):
+    """`clear_shards` drops ONLY the shard namespace and `clear_ranges` only
+    the ranges — the multihost recovery paths rely on that separation (the
+    kv payload halves and the resume publish channel live in shards; a
+    stale-clear must drop both but a range rewrite must not touch a
+    concurrent reader's shards)."""
+    ck = ShardCheckpoint(str(tmp_path), "jobns")
+    ck.save(0, np.arange(4, dtype=np.int32))
+    ck.save_range(1, np.arange(6, dtype=np.int32))
+    ck.clear_shards()
+    assert ck.completed_shards() == []
+    assert ck.completed_ranges() == [1]
+    ck.save(2, np.arange(3, dtype=np.int32))
+    ck.clear_ranges()
+    assert ck.completed_ranges() == []
+    assert ck.completed_shards() == [2]
+
+
+def test_shard_checkpoint_mmap_reads(tmp_path):
+    """`load_mmap` / `load_range_mmap` return mmap-backed arrays equal to
+    their np.load twins — the O(chunk) restore path depends on them."""
+    ck = ShardCheckpoint(str(tmp_path), "jobmm")
+    a = np.arange(1000, dtype=np.int64)
+    ck.save(0, a)
+    ck.save_range(2, a[::-1].copy())
+    m = ck.load_mmap(0)
+    r = ck.load_range_mmap(2)
+    assert isinstance(m, np.memmap) and isinstance(r, np.memmap)
+    np.testing.assert_array_equal(np.asarray(m), a)
+    np.testing.assert_array_equal(np.asarray(r), a[::-1])
+    # Slices materialize only the touched region (basic contract check).
+    np.testing.assert_array_equal(np.asarray(r[10:20]), a[::-1][10:20])
+
+
+def test_merge_split_and_slice_parts():
+    """The resume path's rank-bisection merge slicing is exact on ragged
+    parts with duplicate keys across the split boundary."""
+    from dsort_tpu.parallel.distributed import (
+        _CatParts,
+        _merge_slice,
+        _merge_split,
+    )
+
+    rng = np.random.default_rng(7)
+    a_parts = [
+        np.sort(rng.integers(0, 50, n).astype(np.int32))
+        for n in (0, 37, 5, 113)
+    ]
+    a_flat = np.sort(np.concatenate(a_parts))
+    a_parts = []  # re-split the SORTED stream into ragged consecutive parts
+    off = 0
+    for n in (17, 0, 80, 58):
+        a_parts.append(a_flat[off : off + n])
+        off += n
+    b = np.sort(rng.integers(0, 50, 71).astype(np.int32))
+    a = _CatParts(a_parts)
+    merged = np.sort(np.concatenate([a_flat, b]))
+    total = len(merged)
+    for start, stop in [(0, total), (0, 0), (13, 13), (1, total - 1),
+                        (total // 3, 2 * total // 3)]:
+        got = _merge_slice(a, _CatParts([b]), start, stop)
+        np.testing.assert_array_equal(got, merged[start:stop])
+    for k in (0, 1, total // 2, total):
+        i, j = _merge_split(a, _CatParts([b]), k)
+        assert i + j == k
+
+
 def test_job_recovery_skips_completed_shards(tmp_path):
     """Fail a job midway, then re-run: only lost shards are re-sorted."""
     data = gen_uniform(8_000, seed=33)
