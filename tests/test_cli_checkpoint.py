@@ -113,12 +113,8 @@ def test_merge_split_and_slice_parts():
     )
 
     rng = np.random.default_rng(7)
-    a_parts = [
-        np.sort(rng.integers(0, 50, n).astype(np.int32))
-        for n in (0, 37, 5, 113)
-    ]
-    a_flat = np.sort(np.concatenate(a_parts))
-    a_parts = []  # re-split the SORTED stream into ragged consecutive parts
+    a_flat = np.sort(rng.integers(0, 50, 155).astype(np.int32))
+    a_parts = []  # split the sorted stream into ragged consecutive parts
     off = 0
     for n in (17, 0, 80, 58):
         a_parts.append(a_flat[off : off + n])
@@ -134,6 +130,26 @@ def test_merge_split_and_slice_parts():
     for k in (0, 1, total // 2, total):
         i, j = _merge_split(a, _CatParts([b]), k)
         assert i + j == k
+
+
+def test_global_fingerprint_empty_host_layout_stable():
+    """An EMPTY-ingest host must compute the same dtype tag (and row
+    layout) as its peers — widths come from metadata, never inferred from
+    the data — or resume control flow diverges across processes and the
+    barriers deadlock (r5 review finding)."""
+    from dsort_tpu.parallel.distributed import _global_fingerprint
+
+    k = np.arange(5, dtype=np.uint64)
+    v = np.zeros((5, 92), np.uint8)
+    fp_full, total_full = _global_fingerprint(k, payload=v)
+    fp_empty, total_empty = _global_fingerprint(k[:0], payload=v[:0])
+    # fp format is "total:dt:checksum" — the dt segment must match.
+    assert fp_full.split(":")[1] == fp_empty.split(":")[1]
+    assert total_full == 5 and total_empty == 0
+    # Keys-only path too.
+    fk, _ = _global_fingerprint(k)
+    fe, _ = _global_fingerprint(k[:0])
+    assert fk.split(":")[1] == fe.split(":")[1]
 
 
 def test_job_recovery_skips_completed_shards(tmp_path):
