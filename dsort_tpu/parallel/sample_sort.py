@@ -957,11 +957,13 @@ class BatchSampleSort:
                     sk, counts = pad_to_shards(k, p, cap=cap)
                 ks[b] = sk.reshape(-1)
                 cs[b] = counts
+            # ONE device_put straight from numpy — no jnp.asarray staging
+            # hop (the same data-plane rule as `_sort_ranges_impl`).
             sharding = NamedSharding(self.mesh, P(self.dp_axis, self.axis))
-            xj = jax.device_put(jnp.asarray(ks), sharding)
-            cj = jax.device_put(jnp.asarray(cs), sharding)
             if kv:
-                vj = jax.device_put(jnp.asarray(vs), sharding)
+                xj, cj, vj = jax.device_put((ks, cs, vs), sharding)
+            else:
+                xj, cj = jax.device_put((ks, cs), sharding)
         cap_pair = cap_pair_policy(cap, self.job.capacity_factor, p)
         for _ in range(self.job.max_capacity_retries + 1):
             with timer.phase("spmd_sort"):
@@ -984,19 +986,33 @@ class BatchSampleSort:
         else:
             raise RuntimeError("sample sort bucket overflow after max retries")
         with timer.phase("assemble"):
-            mk = np.asarray(out_k).reshape(batch, p, -1)
+            # ONE fetch for everything the assemble needs (keys + payloads
+            # ride a single device_get — the file's one-fetch doctrine),
+            # then per-job output buffers filled worker-run by worker-run
+            # with no per-worker concat.  The (dp, w)-sharded array's
+            # shards do not map 1:1 to jobs, so per-shard overlapped
+            # fetches do not apply here.
+            if kv:
+                mk, mv = jax.device_get((out_k, out_v))
+                mv = mv.reshape((batch, p, -1) + trailing)
+            else:
+                mk = np.asarray(out_k)
+            mk = mk.reshape(batch, p, -1)
             c = c.reshape(batch, p)
-            keys_out = [
-                np.concatenate([mk[b, i, : c[b, i]] for i in range(p)])
-                for b in range(n_jobs)
-            ]
+
+            def job_out(m, b):
+                n_b = int(c[b].sum())
+                out = np.empty((n_b,) + m.shape[3:], dtype=m.dtype)
+                off = 0
+                for i in range(p):
+                    ci = int(c[b, i])
+                    out[off : off + ci] = m[b, i, :ci]
+                    off += ci
+                return out
+
+            keys_out = [job_out(mk, b) for b in range(n_jobs)]
             if not kv:
                 return keys_out
-            mv = np.asarray(out_v).reshape((batch, p, mk.shape[2]) + trailing)
             return [
-                (
-                    keys_out[b],
-                    np.concatenate([mv[b, i, : c[b, i]] for i in range(p)]),
-                )
-                for b in range(n_jobs)
+                (keys_out[b], job_out(mv, b)) for b in range(n_jobs)
             ]
