@@ -34,6 +34,12 @@ class ShardCheckpoint:
     #: time anyone resumes the job, comfortably older.
     TMP_SWEEP_AGE_S = 60.0
 
+    #: Optional `utils.events.EventLog`: schedulers attach their job's
+    #: journal here (``ckpt.journal = metrics.journal``) so every persist is
+    #: a ``checkpoint_persist`` event on the fault timeline.  Class default
+    #: None keeps the store dependency-free and journal-optional.
+    journal = None
+
     def __init__(self, root: str, job_id: str):
         # Defense in depth against path escape: a job_id like '..' would
         # resolve outside `root`, and clear() rmtrees self.dir — refuse
@@ -130,6 +136,10 @@ class ShardCheckpoint:
         tmp = f"{path}.{self._token}.tmp.npy"
         np.save(tmp, np.asarray(arr))
         os.replace(tmp, path)
+        if self.journal is not None:
+            self.journal.emit(
+                "checkpoint_persist", kind="shard", id=shard_id, n=len(arr)
+            )
 
     def load(self, shard_id: int) -> np.ndarray:
         return np.load(self._shard_path(shard_id))
@@ -169,6 +179,10 @@ class ShardCheckpoint:
         tmp = f"{path}.{self._token}.tmp.npy"
         np.save(tmp, np.asarray(arr))
         os.replace(tmp, path)
+        if self.journal is not None:
+            self.journal.emit(
+                "checkpoint_persist", kind="range", id=range_id, n=len(arr)
+            )
 
     def load_range(self, range_id: int) -> np.ndarray:
         return np.load(self._range_path(range_id))
@@ -196,3 +210,5 @@ class ShardCheckpoint:
     def clear(self) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)
         os.makedirs(self.dir, exist_ok=True)
+        if self.journal is not None:
+            self.journal.emit("checkpoint_clear", reason="stale state")

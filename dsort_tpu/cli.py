@@ -166,6 +166,10 @@ def _make_sorter(cfg: SortConfig, mode: str):
                     # by the same in-flight hang detection as the SPMD
                     # collective (VERDICT r3 #1) — a wedged chip makes this
                     # time out and fall back, never block forever.
+                    metrics.event(
+                        "job_start", mode="fused", n_keys=len(data),
+                        job_id=job_id,
+                    )
                     out = sched.run_bounded(
                         lambda: fused_sort_small(
                             data, cfg.job.local_kernel, metrics
@@ -173,6 +177,10 @@ def _make_sorter(cfg: SortConfig, mode: str):
                         n_keys=len(data), tag="fused",
                     )
                     metrics.bump("fused_small_jobs")
+                    metrics.event(
+                        "job_done", n_keys=len(data),
+                        counters=dict(metrics.counters),
+                    )
                     fused_cold_latch_ts[0] = 0.0
                     fused_cold_streak[0] = 0
                     return out
@@ -228,6 +236,10 @@ def _make_sorter(cfg: SortConfig, mode: str):
                             )
                             fused_cold_latch_ts[0] = time.monotonic()
                     metrics.bump("fused_fallbacks")
+                    metrics.event(
+                        "fused_fallback",
+                        reason=str(e).splitlines()[0][:120],
+                    )
                     log.warning(
                         "fused small-job path failed (%s); retrying on the "
                         "SPMD scheduler", str(e).splitlines()[0][:120],
@@ -261,12 +273,14 @@ def _make_sorter(cfg: SortConfig, mode: str):
     raise SystemExit(f"unknown mode {mode!r}")
 
 
-def _run_one(sorter, in_path: str, out_path: str, dtype, job_id=None) -> None:
+def _run_one(
+    sorter, in_path: str, out_path: str, dtype, job_id=None, journal=None
+) -> None:
     from dsort_tpu.data.ingest import read_ints_file, write_ints_file
 
     t0 = time.perf_counter()
     data = read_ints_file(in_path, dtype=dtype)
-    metrics = Metrics()
+    metrics = Metrics(journal=journal)
     out = sorter(data, metrics, job_id=job_id)
     write_ints_file(out_path, out)
     dt = time.perf_counter() - t0
@@ -277,6 +291,25 @@ def _run_one(sorter, in_path: str, out_path: str, dtype, job_id=None) -> None:
     )
 
 
+def _open_journal(args):
+    """An `EventLog` when ``--journal PATH`` was given, else None."""
+    if not getattr(args, "journal", None):
+        return None
+    from dsort_tpu.utils.events import EventLog
+
+    return EventLog()
+
+
+def _write_journal(journal, args) -> None:
+    if journal is not None:
+        # Append-only flush: serve/coordinator call this after EVERY job of
+        # a session, and rewriting the whole file each time would be
+        # O(session^2) IO.
+        journal.flush_jsonl(args.journal)
+        log.info("event journal written to %s (%d events)",
+                 args.journal, len(journal))
+
+
 def cmd_run(args) -> int:
     from dsort_tpu.utils.tracing import profile_trace
 
@@ -285,11 +318,17 @@ def cmd_run(args) -> int:
     job_id = (
         _job_id_for(args.input, args.job_id) if cfg.job.checkpoint_dir else None
     )
-    with profile_trace(getattr(args, "profile_dir", None)):
-        _run_one(
-            sorter, args.input, args.output or cfg.output_path,
-            np.dtype(cfg.job.key_dtype), job_id=job_id,
-        )
+    journal = _open_journal(args)
+    try:
+        with profile_trace(getattr(args, "profile_dir", None)):
+            _run_one(
+                sorter, args.input, args.output or cfg.output_path,
+                np.dtype(cfg.job.key_dtype), job_id=job_id, journal=journal,
+            )
+    finally:
+        # The journal exists to answer "what happened" — a failed job's
+        # fault timeline must land on disk too.
+        _write_journal(journal, args)
     if getattr(args, "profile_dir", None):
         log.info("profiler trace written to %s", args.profile_dir)
     return 0
@@ -300,6 +339,7 @@ def cmd_serve(args) -> int:
     cfg = _load_config(args)
     sorter = _make_sorter(cfg, args.mode)
     dtype = np.dtype(cfg.job.key_dtype)
+    journal = _open_journal(args)
     if args.job_id and cfg.job.checkpoint_dir:
         # One explicit id across many REPL inputs would make every new file
         # clear the previous file's checkpoints (fingerprint mismatch) —
@@ -328,9 +368,13 @@ def cmd_serve(args) -> int:
                 _job_id_for(name, None) if cfg.job.checkpoint_dir else None
             )
             _run_one(sorter, name, args.output or cfg.output_path, dtype,
-                     job_id=jid)
+                     job_id=jid, journal=journal)
         except Exception as e:  # a bad job must not kill the server
             log.error("job failed: %s", e)
+        finally:
+            # One cumulative journal across REPL jobs, rewritten after each
+            # so a later crash never loses earlier jobs' timelines.
+            _write_journal(journal, args)
 
 
 _REF_KEYS_PER_SEC = 16_384 / 0.374  # BASELINE.md measured reference throughput
@@ -397,8 +441,63 @@ def _bench_suite(args) -> int:
     # reference job (server.c:160-268) in ~2 tunnel round trips.
     from dsort_tpu.models.pipelines import fused_sort_small
 
-    timed("config1_reference_workload_16384_int32", len(ref), "keys/sec",
-          lambda: fused_sort_small(ref), mode="fused_local")
+    # Floor decomposition for the one head-to-head row the reference
+    # defines (VERDICT r5 next #8): `device_ms` is the pure executable cost
+    # (slope over k back-to-back runs on device-resident input — queued
+    # executions amortize dispatch, one fetch at the end), and
+    # `fixed_overhead_ms_per_dispatch` is the e2e single-job wall minus
+    # that — the tunnel round-trip + dispatch floor the headline ratio is
+    # actually bound by.  Attributable from the artifact alone.  The e2e
+    # reps measured here ARE the config1 line (emitted inline in timed()'s
+    # shape) — re-running them through timed() would double config1's wall
+    # cost for the same min.
+    c1_label = "config1_reference_workload_16384_int32"
+    try:
+        from dsort_tpu.models.pipelines import _fused_small_fn
+
+        import jax as _jax
+
+        n1 = len(ref)
+        f1 = _fused_small_fn(n1, str(ref.dtype), "auto")  # n1 is 2^14: no pad
+        # DEVICE-resident input: a host buffer would re-pay H2D on every
+        # chained call and inflate device_ms with transfer cost.
+        buf1 = _jax.device_put(np.ascontiguousarray(ref))
+        np.asarray(f1(buf1, np.int32(n1))[-1:])  # warm/compile
+
+        def _dev_total(k: int) -> float:
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    y = f1(buf1, np.int32(n1))
+                np.asarray(y[-1:])
+                times.append(time.perf_counter() - t0)
+            return float(min(times))
+
+        device_s = max((_dev_total(10) - _dev_total(2)) / 8, 0.0)
+        fused_sort_small(ref)  # warm the host-path wrapper
+        e2e_times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fused_sort_small(ref)
+            e2e_times.append(time.perf_counter() - t0)
+        e2e_s = float(min(e2e_times))  # min: one-sided tunnel jitter
+        emit({
+            "metric": c1_label,
+            "value": round(n1 / e2e_s, 1),
+            "unit": "keys/sec",
+            "includes_host_roundtrip": True,
+            "vs_baseline": round(n1 / e2e_s / _REF_KEYS_PER_SEC, 2),
+            "mode": "fused_local",
+            "device_ms": round(device_s * 1e3, 3),
+            "fixed_overhead_ms_per_dispatch": round(
+                max(e2e_s - device_s, 0.0) * 1e3, 2
+            ),
+        })
+    except Exception as e:  # decomposition must never sink the ladder
+        log.warning("config1 floor decomposition failed: %s", e)
+        timed(c1_label, len(ref), "keys/sec",
+              lambda: fused_sort_small(ref), mode="fused_local")
     u32 = gen_uniform(1 << 20, seed=1)
     timed("config2_uniform_1M_int32_spmd", len(u32), "keys/sec",
           lambda: ss32.sort(u32))
@@ -448,12 +547,18 @@ def cmd_bench(args) -> int:
     cfg = _load_config(args)
     sorter = _make_sorter(cfg, args.mode)
     data = gen_uniform(args.n, dtype=np.dtype(cfg.job.key_dtype), seed=0)
+    journal = _open_journal(args)
     sorter(data, Metrics())  # warm/compile
     times = []
-    for _ in range(args.reps):
-        t0 = time.perf_counter()
-        sorter(data, Metrics())
-        times.append(time.perf_counter() - t0)
+    try:
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            sorter(data, Metrics(journal=journal))
+            times.append(time.perf_counter() - t0)
+    finally:
+        # Same discipline as run/serve/batch: a rep that crashes must not
+        # lose the journal of the reps that did complete.
+        _write_journal(journal, args)
     dt = float(min(times))  # one-sided tunnel jitter; see _bench_suite
     print(
         json.dumps(
@@ -506,7 +611,8 @@ def cmd_batch(args) -> int:
     os.makedirs(args.outdir, exist_ok=True)
     t0 = time.perf_counter()
     jobs = [read_ints_file(p, dtype=dtype) for p in args.inputs]
-    metrics = Metrics()
+    journal = _open_journal(args)
+    metrics = Metrics(journal=journal)
     # With --checkpoint-dir each file's sorted result persists under its
     # basename: a killed batch re-run restores completed files and re-packs
     # the buckets over the missing ones (VERDICT r3 #7).  Ids must be
@@ -522,9 +628,12 @@ def cmd_batch(args) -> int:
                 "these inputs sanitize to the same checkpoint id(s) "
                 f"{id_dupes}; rename the files or drop --checkpoint-dir"
             )
-    outs = BatchSampleSort(mesh, cfg.job).sort(
-        jobs, metrics=metrics, job_ids=job_ids
-    )
+    try:
+        outs = BatchSampleSort(mesh, cfg.job).sort(
+            jobs, metrics=metrics, job_ids=job_ids
+        )
+    finally:
+        _write_journal(journal, args)
     for src, out in zip(args.inputs, outs):
         write_ints_file(os.path.join(args.outdir, os.path.basename(src)), out)
     dt = time.perf_counter() - t0
@@ -700,6 +809,27 @@ def cmd_validate(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_report(args) -> int:
+    """Render a job's event journal: human timeline + phase/counter tables.
+
+    The second consumer of the journal (`dsort run --journal out.jsonl`
+    writes it); ``--chrome-trace`` additionally exports a Perfetto
+    ``trace_event`` file that loads next to a ``jax.profiler`` capture.
+    """
+    import json as _json
+
+    from dsort_tpu.utils.events import EventLog, format_report, to_chrome_trace
+
+    records = EventLog.read_jsonl(args.journal)
+    print(format_report(records), end="")
+    if args.chrome_trace:
+        with open(args.chrome_trace, "w", encoding="utf-8") as f:
+            _json.dump(to_chrome_trace(records), f)
+        log.info("chrome trace written to %s (load in Perfetto / "
+                 "chrome://tracing)", args.chrome_trace)
+    return 0
+
+
 def cmd_coordinator(args) -> int:
     """Run the native coordinator and serve REPL jobs over the cluster."""
     from dsort_tpu.runtime import NativeCoordinator
@@ -715,6 +845,7 @@ def cmd_coordinator(args) -> int:
         log.info("coordinator listening on port %d", coord.port)
         coord.wait_workers(nworkers, timeout_s=args.join_timeout)
         log.info("%d workers joined", nworkers)
+        journal = _open_journal(args)
         while True:
             try:
                 line = input("Enter the filename to sort (or 'exit' to quit): ")
@@ -732,7 +863,7 @@ def cmd_coordinator(args) -> int:
                 continue
             try:
                 data = read_ints_file(name, dtype=dtype)
-                metrics = Metrics()
+                metrics = Metrics(journal=journal)
                 out = coord.run_job(data, num_shards=nworkers, metrics=metrics)
                 write_ints_file(args.output or cfg.output_path, out)
                 log.info(
@@ -741,6 +872,11 @@ def cmd_coordinator(args) -> int:
                 )
             except Exception as e:
                 log.error("job failed: %s", e)
+            finally:
+                # Cumulative across REPL jobs, rewritten after each (same
+                # discipline as `dsort serve`): the native cluster's fault
+                # timeline lands on disk even when a job fails.
+                _write_journal(journal, args)
 
 
 def main(argv=None) -> int:
@@ -771,6 +907,9 @@ def main(argv=None) -> int:
                             "of the same input resumes instead of re-sorting")
         p.add_argument("--job-id",
                        help="checkpoint namespace (default: input basename)")
+        p.add_argument("--journal",
+                       help="write the job's structured event journal "
+                            "(JSONL) here; render with `dsort report`")
         p.add_argument("-o", "--output")
 
     p = sub.add_parser("run", help="sort one file")
@@ -850,6 +989,14 @@ def main(argv=None) -> int:
                    help="treat files as raw binary key arrays (streamed)")
     p.add_argument("--dtype", default="int32")
     p.set_defaults(fn=cmd_validate)
+
+    p = sub.add_parser(
+        "report", help="render an event journal (timeline + phases/counters)"
+    )
+    p.add_argument("journal", help="journal JSONL from `dsort run --journal`")
+    p.add_argument("--chrome-trace",
+                   help="also export a Perfetto trace_event JSON here")
+    p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser("coordinator", help="native TCP coordinator + job REPL")
     common(p)  # provides --workers (cluster size; default 4 below)

@@ -119,9 +119,11 @@ class GatherMergeSort:
         self.axis = axis_name
         self.num_workers = mesh.shape[axis_name]
 
+        from dsort_tpu.utils.compat import shard_map
+
         @functools.partial(jax.jit, out_shardings=None)
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(P(axis_name, None), P(axis_name)),
             out_specs=(P(axis_name, None), P(axis_name)),

@@ -195,6 +195,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from dsort_tpu.utils.compat import enable_x64 as _compat_enable_x64
+from dsort_tpu.utils.compat import tpu_compiler_params as _compat_tpu_compiler_params
+
 from dsort_tpu.ops.bitonic import _ceil_pow2
 from dsort_tpu.ops.local_sort import sentinel_for
 from dsort_tpu.ops.pallas_sort import _on_tpu
@@ -605,7 +608,7 @@ def _span_low(xs, rows: int, m_hi: int, interpret: bool, kb_start: int = 2):
     spec = pl.BlockSpec(
         (span_rows, LANES), lambda g: (g, 0), memory_space=pltpu.VMEM
     )
-    with jax.enable_x64(False):  # see _tile_sort_cm
+    with _compat_enable_x64(False):  # see _tile_sort_cm
         out = pl.pallas_call(
             functools.partial(
                 _span_low_kernel, rows=rows, m_hi=m_hi, np_=len(xs),
@@ -615,7 +618,7 @@ def _span_low(xs, rows: int, m_hi: int, interpret: bool, kb_start: int = 2):
             grid=(t,),
             in_specs=[spec] * len(xs),
             out_specs=tuple([spec] * len(xs)),
-            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 << 20),
+            compiler_params=_compat_tpu_compiler_params(vmem_limit_bytes=110 << 20),
             interpret=interpret,
         )(*xs)
     return out
@@ -649,7 +652,7 @@ def _tile_sort_cm(xs, rows: int, interpret: bool):
     # Trace with x64 disabled: the framework enables jax_enable_x64 globally
     # (int64 key dtypes), which makes jnp promote gather indices to int64 —
     # unsupported inside Mosaic kernels.  Every plane here is 32-bit.
-    with jax.enable_x64(False):
+    with _compat_enable_x64(False):
         out = pl.pallas_call(
             functools.partial(
                 _tile_sort_cm_kernel,
@@ -673,7 +676,7 @@ def _sort_levels(xs, rows: int, k_start: int, parity: bool, interpret: bool):
     import jax.experimental.pallas as pl
 
     t = xs[0].shape[0] // rows
-    with jax.enable_x64(False):  # see _tile_sort_cm
+    with _compat_enable_x64(False):  # see _tile_sort_cm
         out = pl.pallas_call(
             functools.partial(
                 _sort_levels_kernel,
@@ -704,7 +707,7 @@ def _cross(xs, k_over_b, rows: int, m: int, interpret: bool):
         memory_space=pltpu.VMEM,
     )
     smem = pl.BlockSpec((1, 1), lambda a, c: (0, 0), memory_space=pltpu.SMEM)
-    with jax.enable_x64(False):  # see _tile_sort_cm
+    with _compat_enable_x64(False):  # see _tile_sort_cm
         out = pl.pallas_call(
             functools.partial(_cross_kernel, m=m, np_=len(xs)),
             out_shape=_shapes(x5),
@@ -757,7 +760,7 @@ def _orbit(xs, rows: int, mid: int, stride: int, kb_shift: int, interpret: bool)
         lambda h, s: (h, 0, s, 0, 0),
         memory_space=pltpu.VMEM,
     )
-    with jax.enable_x64(False):  # see _tile_sort_cm
+    with _compat_enable_x64(False):  # see _tile_sort_cm
         out = pl.pallas_call(
             functools.partial(
                 _orbit_kernel, mid=mid, rows=rows, kb_shift=kb_shift,
@@ -767,7 +770,7 @@ def _orbit(xs, rows: int, mid: int, stride: int, kb_shift: int, interpret: bool)
             grid=(hi_cnt, stride),
             in_specs=[spec] * len(xs),
             out_specs=tuple([spec] * len(xs)),
-            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 << 20),
+            compiler_params=_compat_tpu_compiler_params(vmem_limit_bytes=110 << 20),
             interpret=interpret,
         )(*x5)
     return tuple(o.reshape(xs[0].shape) for o in out)
@@ -820,7 +823,7 @@ def _span_tail(xs, k_over_b, rows: int, m_hi: int, interpret: bool):
     spec = pl.BlockSpec(
         (span_rows, LANES), lambda g: (g, 0), memory_space=pltpu.VMEM
     )
-    with jax.enable_x64(False):  # see _tile_sort_cm
+    with _compat_enable_x64(False):  # see _tile_sort_cm
         out = pl.pallas_call(
             functools.partial(
                 _span_tail_kernel, rows=rows, m_hi=m_hi, np_=len(xs)
@@ -829,7 +832,7 @@ def _span_tail(xs, k_over_b, rows: int, m_hi: int, interpret: bool):
             grid=(t,),
             in_specs=[_smem_scalar()] + [spec] * len(xs),
             out_specs=tuple([spec] * len(xs)),
-            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 << 20),
+            compiler_params=_compat_tpu_compiler_params(vmem_limit_bytes=110 << 20),
             interpret=interpret,
         )(k_over_b, *xs)
     return out

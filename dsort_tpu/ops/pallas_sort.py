@@ -26,6 +26,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from dsort_tpu.utils.compat import enable_x64 as _compat_enable_x64
+
 from dsort_tpu.ops.bitonic import _ceil_pow2, merge_sorted_runs
 from dsort_tpu.ops.local_sort import sentinel_for
 
@@ -79,7 +81,7 @@ def _tile_sort(x2d: jax.Array, rows: int, interpret: bool) -> jax.Array:
     # Trace with x64 disabled: under the framework's global x64 (int64 key
     # dtypes) python-int roll amounts/indices promote to i64, which Mosaic
     # ops (tpu.dynamic_rotate & co) reject — same guard as ops.block_sort.
-    with jax.enable_x64(False):
+    with _compat_enable_x64(False):
         return pl.pallas_call(
             functools.partial(_tile_bitonic_kernel, rows=rows),
             out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
@@ -163,7 +165,7 @@ def _tile_sort_kv(k2d: jax.Array, v2d: jax.Array, rows: int, interpret: bool):
     spec = lambda dt: pl.BlockSpec(
         (rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
-    with jax.enable_x64(False):  # see _tile_sort
+    with _compat_enable_x64(False):  # see _tile_sort
         return pl.pallas_call(
             functools.partial(_tile_bitonic_kv_kernel, rows=rows),
             out_shape=(
@@ -279,7 +281,7 @@ def radix_histogram(
     padded_n = num_tiles * tile
     xp = jnp.concatenate([x, jnp.zeros(padded_n - n, dtype=x.dtype)])
 
-    with jax.enable_x64(False):  # see _tile_sort
+    with _compat_enable_x64(False):  # see _tile_sort
         out = pl.pallas_call(
             functools.partial(_tile_histogram_kernel, shift=shift, bits=bits),
             out_shape=jax.ShapeDtypeStruct((out_rows, LANES), jnp.int32),

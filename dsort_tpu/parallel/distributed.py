@@ -87,6 +87,7 @@ def _build_mh_program(
         _sample_sort_kv_shard,
         _sample_sort_shard,
     )
+    from dsort_tpu.utils.compat import shard_map
 
     kw = dict(
         num_workers=p_total,
@@ -105,7 +106,7 @@ def _build_mh_program(
         fn = functools.partial(_sample_sort_kv2_shard, kernel=kernel, **kw)
         n_in, n_out = 4, 6
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             fn,
             mesh=mesh,
             in_specs=(P(axis_name),) * n_in,
@@ -289,11 +290,20 @@ def sort_local_shards(
         return out, off
     job = job or JobConfig()
     metrics = metrics if metrics is not None else Metrics()
+    metrics.event(
+        "job_start", mode="multihost", n_keys=len(local_data), job_id=job_id,
+        process=jax.process_index(),
+    )
     if job.checkpoint_dir and job_id:
-        return _sort_local_shards_ckpt(
+        out = _sort_local_shards_ckpt(
             local_data, job, axis_name, metrics, job_id
         )
-    return _sort_local_shards_plain(local_data, job, axis_name, metrics)
+    else:
+        out = _sort_local_shards_plain(local_data, job, axis_name, metrics)
+    metrics.event(
+        "job_done", n_keys=len(out[0]), counters=dict(metrics.counters)
+    )
+    return out
 
 
 def _sort_local_shards_plain(local_data, job, axis_name, metrics):
@@ -340,6 +350,7 @@ def _sort_local_shards_plain(local_data, job, axis_name, metrics):
 
         observed = int(global_max(max_len))
         cap_pair = next_cap_pair(observed, cap_pair, cap, p_total)
+        metrics.event("capacity_retry", observed=observed, cap_pair=cap_pair)
         log.warning("multihost bucket overflow (max bucket %d): retrying with "
                     "cap_pair=%d", observed, cap_pair)
     else:
@@ -478,6 +489,7 @@ def _sort_local_shards_ckpt(local_data, job, axis_name, metrics, job_id):
     pid, nprocs = jax.process_index(), jax.process_count()
     fp, total = _global_fingerprint(local_data)
     ckpt = ShardCheckpoint(job.checkpoint_dir, job_id)
+    ckpt.journal = metrics.journal
     man = ckpt.manifest()
     valid = (
         man is not None
@@ -494,6 +506,9 @@ def _sort_local_shards_ckpt(local_data, job, axis_name, metrics, job_id):
         if done and len(done) == n_ranges:
             parts = [ckpt.load_range_mmap(i) for i in sorted(done)]
             metrics.bump("multihost_ranges_restored", len(done))
+            metrics.event(
+                "checkpoint_restore", kind="multihost_full", n=len(done)
+            )
             log.info(
                 "multihost job %r fully restored from %d ranges",
                 job_id, len(done),
@@ -587,6 +602,10 @@ def _mh_resume_missing(
     )
     metrics.bump("multihost_ranges_restored", len(done))
     metrics.bump("multihost_resort_keys", len(subset))
+    metrics.event(
+        "checkpoint_restore", kind="multihost_partial", n=len(done),
+        resort_keys=len(subset),
+    )
     log.warning(
         "multihost resume of %r: %d/%d ranges restored; re-sorting %d "
         "local keys", job_id, len(done), int(man["n_ranges"]), len(subset),
@@ -669,13 +688,22 @@ def sort_local_records(
         )
     job = job or JobConfig()
     metrics = metrics if metrics is not None else Metrics()
+    metrics.event(
+        "job_start", mode="multihost_kv", n_keys=len(keys), job_id=job_id,
+        process=jax.process_index(),
+    )
     if job.checkpoint_dir and job_id:
-        return _sort_local_records_ckpt(
+        out = _sort_local_records_ckpt(
             keys, payload, secondary, job, axis_name, metrics, job_id
         )
-    return _sort_local_records_plain(
-        keys, payload, secondary, job, axis_name, metrics
+    else:
+        out = _sort_local_records_plain(
+            keys, payload, secondary, job, axis_name, metrics
+        )
+    metrics.event(
+        "job_done", n_keys=len(out[0]), counters=dict(metrics.counters)
     )
+    return out
 
 
 def _sort_local_records_plain(
@@ -731,6 +759,7 @@ def _sort_local_records_plain(
 
         observed = int(global_max(max_len))  # lockstep: global reduction
         cap_pair = next_cap_pair(observed, cap_pair, cap, p_total)
+        metrics.event("capacity_retry", observed=observed, cap_pair=cap_pair)
         log.warning("multihost kv overflow (max bucket %d): retrying with "
                     "cap_pair=%d", observed, cap_pair)
     else:
@@ -772,6 +801,7 @@ def _sort_local_records_ckpt(
         )
     fp, total = _global_fingerprint(keys, payload=fp_payload)
     ckpt = ShardCheckpoint(job.checkpoint_dir, job_id)
+    ckpt.journal = metrics.journal
     man = ckpt.manifest()
     valid = (
         man is not None
@@ -803,6 +833,7 @@ def _sort_local_records_ckpt(
         if done or any(ckpt.has(i) for i in range(n_ranges)):
             # Partial kv checkpoints re-sort: record-level value
             # reconstruction is keys-only for now (see docstring).
+            metrics.event("checkpoint_clear", reason="partial kv checkpoint")
             if pid == 0:
                 log.warning(
                     "multihost kv checkpoint for %r is partial; re-sorting",

@@ -37,6 +37,7 @@ from dsort_tpu.config import JobConfig
 from dsort_tpu.data.partition import pad_kv_to_shards, pad_to_shards
 from dsort_tpu.ops.float_order import is_float_key_dtype, sort_float_keys_via_uint
 from dsort_tpu.ops.local_sort import sentinel_for, sort_keys, sort_padded
+from dsort_tpu.utils.compat import shard_map
 from dsort_tpu.utils.logging import get_logger
 from dsort_tpu.utils.metrics import Metrics, PhaseTimer
 
@@ -462,7 +463,7 @@ class SampleSort:
             else ()
         )
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
             ),
@@ -565,6 +566,9 @@ class SampleSort:
             # converges: splitters are deterministic for the same data).
             observed = int(ml.max())
             cap_pair = next_cap_pair(observed, cap_pair, n_local, p)
+            metrics.event(
+                "capacity_retry", observed=observed, cap_pair=cap_pair
+            )
             log.warning(
                 "bucket overflow (attempt %d, max bucket %d): retrying with "
                 "cap_pair=%d", attempt + 1, observed, cap_pair,
@@ -660,6 +664,9 @@ class SampleSort:
             metrics.bump("capacity_retries")
             observed = int(ml.max())
             cap_pair = next_cap_pair(observed, cap_pair, n_local, p)
+            metrics.event(
+                "capacity_retry", observed=observed, cap_pair=cap_pair
+            )
         else:
             raise RuntimeError("sample sort bucket overflow after max retries")
         with timer.phase("assemble"):
@@ -720,7 +727,7 @@ class BatchSampleSort:
             return jax.vmap(shard_fn)(xs_b, counts_b)
 
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(self.dp_axis, self.axis),) * 2,
@@ -855,7 +862,7 @@ class BatchSampleSort:
             return jax.vmap(shard_fn)(ks_b, vs_b, cs_b)
 
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(self.dp_axis, self.axis),) * 3,
@@ -981,6 +988,9 @@ class BatchSampleSort:
             metrics.bump("capacity_retries")
             observed = int(ml.max())
             cap_pair = next_cap_pair(observed, cap_pair, cap, p)
+            metrics.event(
+                "capacity_retry", observed=observed, cap_pair=cap_pair
+            )
             log.warning("batch overflow (max bucket %d): retrying with "
                         "cap_pair=%d", observed, cap_pair)
         else:

@@ -60,6 +60,29 @@ class NativeCoordinator:
         """Fault injection: hard-close worker w's connection."""
         self._lib.dsort_coord_kill_worker(self._h, w)
 
+    def drain_events(self, metrics: Metrics | None) -> list[dict]:
+        """Pull the C++ coordinator's buffered state-transition lines.
+
+        Each compact native line ("t=... ev=worker_dead w=1") becomes one
+        record on the job's event journal (when ``metrics.journal`` is
+        attached), so the native cluster's fault timeline — joins, deaths,
+        reassignments, heartbeat lapses — lands in the SAME stream as every
+        other execution mode's.  Returns the parsed records either way.
+        """
+        from dsort_tpu.runtime import native
+
+        if not self._h:
+            return []
+        recs = native.coord_drain_events(self._h)
+        journal = getattr(metrics, "journal", None)
+        if journal is not None:
+            for r in recs:
+                fields = {
+                    k: v for k, v in r.items() if k not in ("type", "t", "mono")
+                }
+                journal.ingest(r["t"], r["mono"], r["type"], **fields)
+        return recs
+
     def submit(self, task_id: int, data: np.ndarray) -> None:
         data = np.ascontiguousarray(data)
         rc = self._lib.dsort_coord_submit(
@@ -104,15 +127,21 @@ class NativeCoordinator:
         # --dtype frame contract, which the coordinator cannot renegotiate.
         with timer.phase("partition"):
             shards = partition(data, num_shards)
-        with timer.phase("dispatch"):
-            for i, s in enumerate(shards):
-                self.submit(i, s)
-        with timer.phase("collect"):
-            results = [
-                self.collect(i, data.dtype, max_elems=len(shards[i]) or 1)
-                for i in range(num_shards)
-            ]
-        metrics.bump("reassignments", self.reassignments)
+        try:
+            with timer.phase("dispatch"):
+                for i, s in enumerate(shards):
+                    self.submit(i, s)
+            with timer.phase("collect"):
+                results = [
+                    self.collect(i, data.dtype, max_elems=len(shards[i]) or 1)
+                    for i in range(num_shards)
+                ]
+        finally:
+            # Drain even when the job fails: the buffered worker_dead /
+            # reassign / job_failed lines are the explanation of the failure
+            # and must reach the journal.
+            metrics.bump("reassignments", self.reassignments)
+            self.drain_events(metrics)
         with timer.phase("merge"):
             if native.supports_dtype(data.dtype):
                 out = native.kway_merge([r for r in results if len(r)] or [data[:0]])

@@ -143,6 +143,10 @@ def _open_and_bind():
     lib.dsort_coord_kill_worker.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.dsort_coord_reassignments.restype = ctypes.c_int32
     lib.dsort_coord_reassignments.argtypes = [ctypes.c_void_p]
+    lib.dsort_coord_drain_events.restype = ctypes.c_int64
+    lib.dsort_coord_drain_events.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+    ]
     lib.dsort_coord_shutdown.argtypes = [ctypes.c_void_p]
     lib.dsort_coord_destroy.argtypes = [ctypes.c_void_p]
     # ASCII int ingest/egress (textio.cpp).
@@ -463,6 +467,73 @@ def check_order_be(buf, nrec: int, rec_bytes: int, key_bytes: int) -> int:
     lib = _load()
     ptr, keep = _as_ptr(buf)
     return int(lib.dsort_check_order_be(ptr, nrec, rec_bytes, key_bytes))
+
+
+# Native coordinator event lines ("t=<secs> ev=<type> [w=<i>] [task=<id>]",
+# one per state transition, drained via dsort_coord_drain_events) map onto
+# the Python journal's registered types (utils.events.EVENT_TYPES).
+_COORD_EVENT_TYPES = {
+    "worker_join": "worker_join",
+    "worker_dead": "worker_dead",
+    "reassign": "reassign",
+    "attempt_start": "attempt_start",
+    "task_done": "task_done",
+    "job_failed": "job_failed",
+    "heartbeat_lapse": "heartbeat_lapse",
+}
+
+
+def parse_coord_events(text: str) -> list[dict]:
+    """Parse drained native event lines into journal-shaped dicts.
+
+    Each dict has ``type`` (a registered `utils.events` type), ``mono``
+    (the coordinator's steady-clock stamp — the same CLOCK_MONOTONIC base
+    as ``time.monotonic`` in this process), ``t`` (converted to WALL clock
+    via the current mono→wall offset, so native records merge with
+    Python-emitted events' ``t``), and the line's integer fields
+    (``worker``, ``task``).  Malformed lines are skipped, never raised: the
+    journal is a diagnostic surface and must not take down a job.
+    """
+    wall_offset = time.time() - time.monotonic()
+    out = []
+    for line in text.splitlines():
+        kv = {}
+        for tok in line.split():
+            if "=" not in tok:
+                kv = None
+                break
+            k, _, v = tok.partition("=")
+            kv[k] = v
+        if not kv or "ev" not in kv or "t" not in kv:
+            continue
+        etype = _COORD_EVENT_TYPES.get(kv["ev"])
+        if etype is None:
+            continue
+        try:
+            mono = float(kv["t"])
+            rec = {"type": etype, "t": mono + wall_offset, "mono": mono}
+            if "w" in kv:
+                rec["worker"] = int(kv["w"])
+            if "task" in kv:
+                rec["task"] = int(kv["task"])
+        except ValueError:
+            continue
+        out.append(rec)
+    return out
+
+
+def coord_drain_events(handle) -> list[dict]:
+    """Drain and parse the native coordinator's buffered event lines."""
+    lib = _load()
+    if lib is None:
+        return []
+    out: list[dict] = []
+    while True:
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = lib.dsort_coord_drain_events(handle, buf, len(buf))
+        if n <= 0:
+            return out
+        out.extend(parse_coord_events(buf.raw[:n].decode("ascii", "replace")))
 
 
 class NativeWorkerTable:

@@ -27,10 +27,13 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -55,6 +58,7 @@ double now_s() {
 struct Worker {
   int fd = -1;
   bool alive = false;
+  bool hb_lapse_logged = false;  // one lapse event per hang, not per tick
   double last_hb = 0.0;
   // Per-socket send mutex: during reassignment a foreign task borrows a live
   // worker's socket; serialize like the reference's w_socket_mutexes
@@ -201,7 +205,47 @@ class Coordinator {
     return reassignments_;
   }
 
+  // Drain buffered event lines into `buf` (newline-separated, NUL-free).
+  // Copies only WHOLE lines that fit `cap`; drained lines are dropped,
+  // lines that did not fit stay queued for the next drain.  Returns bytes
+  // written.  Lines are "t=<secs> ev=<type> [w=<idx>] [task=<id>]" — one
+  // compact line per coordinator state transition, parsed back into the
+  // Python event journal by runtime/native.py.
+  int64_t drain_events(char* buf, int64_t cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t off = 0;
+    while (!events_.empty()) {
+      const std::string& line = events_.front();
+      int64_t need = static_cast<int64_t>(line.size()) + 1;
+      if (off + need > cap) break;
+      std::memcpy(buf + off, line.data(), line.size());
+      off += static_cast<int64_t>(line.size());
+      buf[off++] = '\n';
+      events_.pop_front();
+    }
+    return off;
+  }
+
  private:
+  // Must be called with mu_ held.  Bounded queue: a consumer that never
+  // drains cannot grow memory without bound (old events drop first).
+  void log_event_locked(const char* type, int w, int64_t task) {
+    char line[96];
+    int n;
+    if (w >= 0 && task >= 0) {
+      n = std::snprintf(line, sizeof(line), "t=%.6f ev=%s w=%d task=%lld",
+                        now_s(), type, w, static_cast<long long>(task));
+    } else if (w >= 0) {
+      n = std::snprintf(line, sizeof(line), "t=%.6f ev=%s w=%d", now_s(),
+                        type, w);
+    } else {
+      n = std::snprintf(line, sizeof(line), "t=%.6f ev=%s task=%lld",
+                        now_s(), type, static_cast<long long>(task));
+    }
+    if (n <= 0) return;
+    if (events_.size() >= 4096) events_.pop_front();
+    events_.emplace_back(line, static_cast<size_t>(n));
+  }
   void accept_loop() {
     while (true) {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
@@ -222,6 +266,7 @@ class Coordinator {
         w.alive = true;
         w.last_hb = now_s();
         ++total_connected_;
+        log_event_locked("worker_join", idx, -1);
         w.reader = std::thread([this, idx] { reader_loop(idx); });
       }
       cv_.notify_all();
@@ -252,6 +297,7 @@ class Coordinator {
           if (it != tasks_.end() && it->second.state == TaskState::kSent) {
             it->second.result = std::move(payload);
             it->second.state = TaskState::kDone;
+            log_event_locked("task_done", widx, h.task_id);
           }
         }
         cv_.notify_all();
@@ -271,10 +317,12 @@ class Coordinator {
       Worker& w = *workers_[widx];
       if (!w.alive) return;
       w.alive = false;
+      log_event_locked("worker_dead", widx, -1);
       for (auto& [id, t] : tasks_) {
         if (t.state == TaskState::kSent && t.assigned == widx) {
           t.state = TaskState::kPending;
           ++reassignments_;  // recv-path detection (server.c:421-448)
+          log_event_locked("reassign", widx, id);
           orphans.push_back(id);
         }
       }
@@ -315,12 +363,14 @@ class Coordinator {
         if (it == tasks_.end()) return false;
         if (target < 0) {
           it->second.state = TaskState::kFailed;  // clean job failure
+          log_event_locked("job_failed", -1, task_id);
           cv_.notify_all();
           return false;
         }
         w = workers_[target].get();
         it->second.assigned = target;
         it->second.state = TaskState::kSent;
+        log_event_locked("attempt_start", target, task_id);
         data_ptr = &it->second.data;
         h.len = data_ptr->size();
       }
@@ -337,10 +387,12 @@ class Coordinator {
         std::lock_guard<std::mutex> lk(mu_);
         if (workers_[target]->alive) {
           workers_[target]->alive = false;
+          log_event_locked("worker_dead", target, -1);
         }
         auto it = tasks_.find(task_id);
         it->second.state = TaskState::kPending;
         ++reassignments_;
+        log_event_locked("reassign", target, task_id);
       }
       cv_.notify_all();
       std::this_thread::sleep_for(std::chrono::milliseconds(100));
@@ -357,9 +409,14 @@ class Coordinator {
         double t = now_s();
         for (int i = 0; i < static_cast<int>(workers_.size()); ++i) {
           Worker& w = *workers_[i];
-          if (w.alive && hb_timeout_ > 0 && t - w.last_hb > hb_timeout_) {
+          if (w.alive && hb_timeout_ > 0 && t - w.last_hb > hb_timeout_ &&
+              !w.hb_lapse_logged) {
             // Hang-blindness fix: no heartbeat -> force the socket closed;
-            // the reader thread then runs the normal death path.
+            // the reader thread then runs the normal death path.  The flag
+            // keeps a delayed reader from producing one lapse event (and
+            // one extra shutdown call) per 200 ms monitor tick.
+            w.hb_lapse_logged = true;
+            log_event_locked("heartbeat_lapse", i, -1);
             ::shutdown(w.fd, SHUT_RDWR);
           }
         }
@@ -376,6 +433,7 @@ class Coordinator {
   std::map<uint32_t, Task> tasks_;
   int total_connected_ = 0;
   int reassignments_ = 0;
+  std::deque<std::string> events_;
   bool stopping_ = false;
   std::thread accept_thread_;
   std::thread monitor_thread_;
@@ -422,6 +480,10 @@ void dsort_coord_kill_worker(void* c, int32_t w) {
 
 int32_t dsort_coord_reassignments(void* c) {
   return static_cast<Coordinator*>(c)->reassignments();
+}
+
+int64_t dsort_coord_drain_events(void* c, char* buf, int64_t cap) {
+  return static_cast<Coordinator*>(c)->drain_events(buf, cap);
 }
 
 void dsort_coord_shutdown(void* c) {

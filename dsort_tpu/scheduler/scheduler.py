@@ -305,6 +305,7 @@ class Scheduler:
             # an earlier run of the same job — skip the sort entirely.
             results[i] = ckpt.load(i)
             metrics.bump("shards_restored")
+            metrics.event("checkpoint_restore", kind="shard", id=i)
             return
         worker = i if self.table.is_alive(i) else -1
         transient_left = self.job.max_transient_retries
@@ -314,6 +315,7 @@ class Scheduler:
                 if worker is None:
                     return  # clean abort; job-level gate raises
             try:
+                metrics.event("attempt_start", shard=i, worker=worker)
                 results[i] = self._attempt(worker, shard, metrics)
                 if ckpt is not None:
                     ckpt.save(i, results[i])
@@ -331,6 +333,7 @@ class Scheduler:
                     # bounded number of times before treating it as death.
                     transient_left -= 1
                     metrics.bump("transient_retries")
+                    metrics.event("transient_retry", shard=i, worker=worker)
                     log.warning(
                         "transient runtime error on worker %d shard %d "
                         "(retries left %d): %s",
@@ -354,14 +357,17 @@ class Scheduler:
                     "worker %d failed during %s of shard %d; reassigning",
                     worker, stage, i,
                 )
-                self.table.mark_dead(worker)
-                metrics.bump("reassignments")
                 if isinstance(e, WorkerWaitTimeout):
                     metrics.bump("heartbeat_timeouts")
+                    metrics.event("heartbeat_lapse", worker=worker, shard=i)
+                self.table.mark_dead(worker)
+                metrics.bump("reassignments")
+                metrics.event("worker_dead", worker=worker, stage=stage)
                 nxt = self.table.first_live()
                 if nxt is None:
                     return
                 log.warning("reassigning shard %d to worker %d", i, nxt)
+                metrics.event("reassign", shard=i, frm=worker, to=nxt)
                 time.sleep(self.job.settle_delay_s)  # server.c:304,391,446
                 worker = nxt
 
@@ -387,6 +393,9 @@ class Scheduler:
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         w = self.executor.num_workers
+        metrics.event(
+            "job_start", mode="taskpool", n_keys=len(data), job_id=job_id
+        )
         self.table.revive_all()  # server.c:222,278
         ckpt = None
         if self.job.checkpoint_dir and job_id:
@@ -394,6 +403,7 @@ class Scheduler:
             from dsort_tpu.models.external_sort import _fingerprint
 
             ckpt = ShardCheckpoint(self.job.checkpoint_dir, job_id)
+            ckpt.journal = metrics.journal
             # Shards outlive successful runs and the CLI derives job_id from
             # the input basename, so a re-run after the file's contents (or
             # the worker count) changed must not serve stale shards
@@ -423,12 +433,19 @@ class Scheduler:
             if e is not None:  # a genuine program error, not a worker death
                 raise e
         if any(r is None for r in results):
+            metrics.event(
+                "job_failed", reason="no live workers remain",
+                counters=dict(metrics.counters),
+            )
             raise JobFailedError(
                 "job failed: no live workers remain "
                 f"(completed {sum(r is not None for r in results)}/{w} shards)"
             )
         with timer.phase("merge"):
             out = merge_sorted_host([r for r in results])
+        metrics.event(
+            "job_done", n_keys=len(data), counters=dict(metrics.counters)
+        )
         return out
 
 
@@ -536,9 +553,15 @@ class SpmdScheduler:
         Returns the newly dead worker indexes (possibly empty: a transient
         runtime fault with all devices healthy).
         """
-        dead = [i for i in live if not self._probe_device(i)]
+        dead = []
+        for i in live:
+            ok = self._probe_device(i)
+            metrics.event("probe", worker=i, ok=bool(ok))
+            if not ok:
+                dead.append(i)
         for i in dead:
             self.table.mark_dead(i)
+            metrics.event("worker_dead", worker=i, stage="probe")
         # Belt and braces: reap anything whose heartbeat (stamped by probes
         # and successful jobs) has lapsed — this is the wired-in consumer of
         # the table's heartbeat timestamps.
@@ -600,6 +623,7 @@ class SpmdScheduler:
                     ckpt.save(i, host[i, : counts[i]])
         else:
             metrics.bump("spmd_phase_restores")
+            metrics.event("checkpoint_restore", kind="local_sort_phase", n=w)
         return np.concatenate([ckpt.load(i) for i in range(w)])
 
     def _shuffle_with_range_checkpoint(
@@ -622,6 +646,9 @@ class SpmdScheduler:
         if n_ranges is not None and done:
             if len(done) == n_ranges:
                 metrics.bump("shuffle_phase_restores")
+                metrics.event(
+                    "checkpoint_restore", kind="shuffle_phase", n=n_ranges
+                )
                 return np.concatenate(
                     [ckpt.load_range(i) for i in sorted(done)]
                 )
@@ -698,6 +725,10 @@ class SpmdScheduler:
         subset = np.concatenate(parts)
         metrics.bump("shuffle_ranges_restored", len(done))
         metrics.bump("shuffle_resort_keys", len(subset))
+        metrics.event(
+            "checkpoint_restore", kind="shuffle_ranges", n=len(done),
+            resort_keys=len(subset),
+        )
         log.warning(
             "shuffle resume: %d/%d ranges restored; re-sorting %d of %d keys",
             len(done), (ckpt.manifest() or {}).get("n_ranges", -1),
@@ -814,6 +845,9 @@ class SpmdScheduler:
             # checkpointed run of raw floats would already have dropped NaNs.
             return sort_float_keys_via_uint(self.sort, data, metrics, job_id)
         metrics = metrics if metrics is not None else Metrics()
+        metrics.event(
+            "job_start", mode="spmd", n_keys=len(data), job_id=job_id
+        )
         self.table.revive_all()
         ckpt = None
         work = data
@@ -822,6 +856,7 @@ class SpmdScheduler:
             from dsort_tpu.models.external_sort import _fingerprint
 
             ckpt = ShardCheckpoint(self.job.checkpoint_dir, job_id)
+            ckpt.journal = metrics.journal
             # A reused job_id with different same-length data must not serve
             # stale shards/ranges (ADVICE r1; one canonical guard shared
             # with the taskpool scheduler — sync_manifest also preserves a
@@ -843,8 +878,13 @@ class SpmdScheduler:
         while True:
             live = self.table.live_workers()
             if not live:
+                metrics.event(
+                    "job_failed", reason="no live devices remain",
+                    counters=dict(metrics.counters),
+                )
                 raise JobFailedError("job failed: no live devices remain")
             devs = [self.devices[i] for i in live]
+            metrics.event("attempt_start", live=list(live))
             cancelled = threading.Event()
 
             def attempt():
@@ -897,6 +937,10 @@ class SpmdScheduler:
                 )
                 for i in live:  # proof of life: the collective completed
                     self.table.heartbeat(i)
+                metrics.event(
+                    "job_done", n_keys=len(data),
+                    counters=dict(metrics.counters),
+                )
                 return out
             except WorkerFailure as e:
                 log.warning(
@@ -904,7 +948,9 @@ class SpmdScheduler:
                     e.worker, len(live) - 1,
                 )
                 self.table.mark_dead(e.worker)
+                metrics.event("worker_dead", worker=e.worker, stage=e.stage)
                 metrics.bump("mesh_reforms")
+                metrics.event("mesh_reform", survivors=len(live) - 1)
                 time.sleep(self.job.settle_delay_s)
             except ProgramWaitTimeout as e:
                 # The in-flight program wait lapsed — the hang the reference
@@ -915,6 +961,7 @@ class SpmdScheduler:
                 # checkpoint IO on a network mount — is NOT this type and
                 # propagates through the generic handler below.)
                 metrics.bump("spmd_wait_timeouts")
+                metrics.event("heartbeat_lapse", kind="spmd_wait")
                 dead = self._reap_after_runtime_error(live, metrics)
                 if dead:
                     log.warning(
@@ -923,10 +970,14 @@ class SpmdScheduler:
                         e, dead, len(live) - len(dead),
                     )
                     metrics.bump("mesh_reforms")
+                    metrics.event(
+                        "mesh_reform", survivors=len(live) - len(dead)
+                    )
                 elif transient_retries < self.job.max_transient_retries:
                     transient_retries += 1
                     wait_lapses += 1
                     metrics.bump("transient_retries")
+                    metrics.event("transient_retry", kind="spmd_wait")
                     log.warning(
                         "in-flight wait timed out with all devices healthy "
                         "(retry %d/%d): %s",
@@ -955,9 +1006,13 @@ class SpmdScheduler:
                         len(live) - len(dead),
                     )
                     metrics.bump("mesh_reforms")
+                    metrics.event(
+                        "mesh_reform", survivors=len(live) - len(dead)
+                    )
                 elif transient_retries < self.job.max_transient_retries:
                     transient_retries += 1
                     metrics.bump("transient_retries")
+                    metrics.event("transient_retry", kind="runtime_error")
                     log.warning(
                         "transient runtime error with all devices healthy "
                         "(retry %d/%d): %s",

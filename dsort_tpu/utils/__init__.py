@@ -1,4 +1,5 @@
-"""Cross-cutting utilities: structured logging, metrics, tracing."""
+"""Cross-cutting utilities: structured logging, metrics, tracing, events."""
 
+from dsort_tpu.utils.events import EventLog  # noqa: F401
 from dsort_tpu.utils.logging import get_logger  # noqa: F401
 from dsort_tpu.utils.metrics import PhaseTimer, Metrics  # noqa: F401

@@ -1,0 +1,48 @@
+"""JAX version compatibility shims.
+
+One import site per drifted API, so version skew is absorbed here instead of
+scattering ``hasattr`` checks through the drivers.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` across the API move.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=...)``; 0.4.x has only
+    ``jax.experimental.shard_map.shard_map(..., check_rep=...)`` (the same
+    replication check under its old name).
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas-TPU compiler params across the `TPUCompilerParams` →
+    `CompilerParams` rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def enable_x64(new_val: bool = True):
+    """`jax.enable_x64` (context manager) across the API move from
+    ``jax.experimental.enable_x64``."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(new_val)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(new_val)

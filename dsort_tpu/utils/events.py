@@ -1,0 +1,286 @@
+"""Structured per-job event journal (the fault-timeline upgrade of §5.5).
+
+The reference's only observability is unleveled printf of protocol steps;
+the rebuild's recovery machinery — heartbeat lapses, device probes, mesh
+re-forms, shard reassignment, capacity retries, checkpoint restores — went
+through leveled logs only, which answer "what happened to job X" solely by
+grepping stderr.  This module is the machine-readable trail: a thread-safe
+`EventLog` of typed, monotonic-timestamped records emitted from every
+execution mode (taskpool, SPMD, fused, multi-host, native coordinator), plus
+its two consumers — a Chrome-trace (Perfetto ``trace_event``) exporter so
+job timelines render next to ``jax.profiler`` captures, and the human
+timeline behind ``dsort report``.
+
+Wiring: an `EventLog` attaches to a `Metrics` instance
+(``Metrics(journal=...)``); every site that already threads metrics can then
+``metrics.event("worker_dead", worker=3)`` with zero cost when no journal is
+attached.  `PhaseTimer` emits ``phase_start``/``phase_end`` pairs
+automatically, so the phase breakdown and the fault timeline live in one
+stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+
+#: THE event-type registry.  `EventLog.emit` refuses unregistered types so
+#: the journal schema stays documented here (and in README "Observability")
+#: rather than drifting site by site.  Fields listed are conventions, not
+#: schema — events carry whatever keyword fields their site provides.
+EVENT_TYPES: dict[str, str] = {
+    "job_start": "a sort job entered a scheduler (n_keys, mode)",
+    "job_done": "the job completed (n_keys)",
+    "job_failed": "the job failed cleanly (reason)",
+    "attempt_start": "one execution attempt began (worker/live, shard)",
+    "heartbeat_lapse": "a bounded wait lapsed — possible hang (worker/kind)",
+    "probe": "a liveness probe ran on one device (worker, ok)",
+    "worker_dead": "a worker/device was declared dead (worker, stage)",
+    "reassign": "a shard moved to another worker (shard, frm, to)",
+    "mesh_reform": "the SPMD mesh re-formed over survivors (survivors)",
+    "capacity_retry": "an all_to_all bucket overflowed; retry resized "
+                      "(observed, cap_pair)",
+    "transient_retry": "a transient runtime error retried in place (worker)",
+    "checkpoint_persist": "shard/range state persisted (kind, id, n)",
+    "checkpoint_restore": "persisted state restored instead of re-sorting "
+                          "(kind, n)",
+    "checkpoint_clear": "stale/partial persisted state was cleared (reason)",
+    "phase_start": "a timed phase opened (phase)",
+    "phase_end": "a timed phase closed (phase, seconds)",
+    "fused_fallback": "the fused small-job path failed over to the "
+                      "scheduler (reason)",
+    "worker_join": "a worker joined the native coordinator cluster (worker)",
+    "task_done": "one shard's result landed (native coordinator; worker, "
+                 "task)",
+}
+
+#: THE counter registry: every `Metrics.bump` name in the package, with its
+#: meaning.  The journal (``job_done`` carries the final counters), bench
+#: artifact lines, and README's Observability section all share this one
+#: vocabulary; ``tests/test_events.py`` greps the source tree to keep it
+#: exhaustive.
+COUNTERS: dict[str, str] = {
+    "reassignments": "shards moved to another worker after a failure",
+    "heartbeat_timeouts": "taskpool attempts abandoned on a lapsed wait",
+    "cold_wait_retries": "cold-key waits extended (likely slow compile)",
+    "transient_retries": "transient runtime errors retried in place",
+    "device_runtime_errors": "real XLA runtime failures routed to recovery",
+    "device_deaths": "devices marked dead after failed probes",
+    "mesh_reforms": "SPMD mesh re-formed over surviving devices",
+    "spmd_wait_timeouts": "bounded in-flight SPMD program waits lapsed",
+    "capacity_retries": "all_to_all bucket overflows resized and re-run",
+    "shards_restored": "taskpool shards served from checkpoint",
+    "spmd_phase_restores": "SPMD local-sort phases restored from checkpoint",
+    "shuffle_phase_restores": "SPMD shuffle phases fully restored",
+    "shuffle_ranges_restored": "persisted shuffle ranges restored",
+    "shuffle_resort_keys": "keys re-sorted by the shuffle resume path",
+    "multihost_ranges_restored": "multi-host per-process ranges restored",
+    "multihost_resort_keys": "keys re-sorted by the multi-host resume path",
+    "batch_jobs_restored": "batched jobs served from checkpoint",
+    "padded_elems": "elements allocated in batched padding layouts",
+    "fused_small_jobs": "jobs served by the fused single-program path",
+    "fused_fallbacks": "fused-path failures retried on the SPMD scheduler",
+    "runs_resumed": "external-sort runs restored from a previous run",
+    "runs_sorted": "external-sort runs sorted this run",
+    "native_merges": "k-way merges executed in native code",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One journal record.  ``t`` is wall-clock (cross-process mergeable);
+    ``mono`` is ``time.monotonic()`` (in-process ordering and durations);
+    ``seq`` is the per-log append index (total order even at equal clocks)."""
+
+    seq: int
+    t: float
+    mono: float
+    type: str
+    fields: dict
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "t": round(self.t, 6),
+            "mono": round(self.mono, 6),
+            "type": self.type,
+            **self.fields,
+        }
+
+
+class EventLog:
+    """Thread-safe, append-only journal of typed events for one job/session."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: list[Event] = []
+        self._flushed = 0  # events already written by flush_jsonl
+
+    def emit(self, etype: str, **fields) -> Event:
+        if etype not in EVENT_TYPES:
+            raise ValueError(
+                f"unregistered event type {etype!r}; add it to "
+                "dsort_tpu.utils.events.EVENT_TYPES"
+            )
+        t, mono = time.time(), time.monotonic()
+        with self._lock:
+            ev = Event(len(self._events), t, mono, etype, fields)
+            self._events.append(ev)
+        return ev
+
+    def ingest(self, t: float, mono: float, etype: str, **fields) -> Event:
+        """Append an event observed elsewhere (the native coordinator's
+        drained lines) with ITS timestamps, under this log's sequence."""
+        if etype not in EVENT_TYPES:
+            raise ValueError(f"unregistered event type {etype!r}")
+        with self._lock:
+            ev = Event(len(self._events), t, mono, etype, fields)
+            self._events.append(ev)
+        return ev
+
+    def events(self) -> list[Event]:
+        with self._lock:
+            return list(self._events)
+
+    def types(self) -> list[str]:
+        """Event types in append order — the sequence tests assert on."""
+        return [e.type for e in self.events()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # -- persistence -------------------------------------------------------
+
+    def write_jsonl(self, path: str) -> None:
+        """One JSON object per line — the ``--journal`` artifact format."""
+        with open(path, "w", encoding="utf-8") as f:
+            for e in self.events():
+                f.write(json.dumps(e.to_dict()) + "\n")
+
+    def flush_jsonl(self, path: str) -> None:
+        """Write only the events not yet flushed (truncating on the FIRST
+        flush so a stale file never mixes sessions).  The per-job persist
+        of long REPL sessions (`dsort serve/coordinator --journal`): IO per
+        job stays O(new events), not O(session)."""
+        with self._lock:
+            events = list(self._events)
+            start = self._flushed
+            self._flushed = len(events)
+        if start == 0 or events[start:]:
+            with open(path, "w" if start == 0 else "a",
+                      encoding="utf-8") as f:
+                for e in events[start:]:
+                    f.write(json.dumps(e.to_dict()) + "\n")
+
+    @staticmethod
+    def read_jsonl(path: str) -> list[dict]:
+        out = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+
+# -- consumer 1: Chrome-trace (Perfetto trace_event) export -----------------
+
+
+def to_chrome_trace(records: list[dict]) -> dict:
+    """Records (``Event.to_dict`` shape) -> a Chrome ``trace_event`` object.
+
+    ``phase_start``/``phase_end`` pairs become B/E duration events (nested
+    per thread of emission is not tracked — phases pair by name, innermost
+    first); everything else becomes an instant event with its fields as
+    ``args``.  Timestamps are microseconds on the monotonic clock, rebased
+    to the first record, so the timeline lines up with a ``jax.profiler``
+    capture of the same run when loaded into Perfetto side by side.
+    """
+    if not records:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    # Chronological, not append, order: ingested native-coordinator records
+    # carry their own (earlier) stamps but append at drain time.
+    records = sorted(records, key=lambda r: (r["mono"], r.get("seq", 0)))
+    t0 = records[0]["mono"]
+    out = []
+    for r in records:
+        us = (r["mono"] - t0) * 1e6
+        args = {
+            k: v
+            for k, v in r.items()
+            if k not in ("seq", "t", "mono", "type")
+        }
+        common = {"pid": 1, "tid": 1, "ts": round(us, 1)}
+        if r["type"] == "phase_start":
+            out.append(
+                {"name": f"dsort:{args.get('phase', '?')}", "ph": "B",
+                 **common}
+            )
+        elif r["type"] == "phase_end":
+            out.append(
+                {"name": f"dsort:{args.get('phase', '?')}", "ph": "E",
+                 **common}
+            )
+        else:
+            out.append(
+                {"name": r["type"], "ph": "i", "s": "g", "args": args,
+                 **common}
+            )
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# -- consumer 2: the human timeline behind `dsort report` -------------------
+
+
+def format_report(records: list[dict]) -> str:
+    """Human timeline + phase/counter tables for one journal.
+
+    The timeline shows every non-phase event at its relative time; the phase
+    table aggregates ``phase_end`` durations; the counter table shows the
+    final counters carried by the last ``job_done``/``job_failed`` event
+    (the schedulers attach them there).
+    """
+    if not records:
+        return "(empty journal)\n"
+    # Chronological order (see to_chrome_trace: ingested native records
+    # append late but stamp early).
+    records = sorted(records, key=lambda r: (r["mono"], r.get("seq", 0)))
+    t0 = records[0]["mono"]
+    lines = ["timeline:"]
+    phase_s: dict[str, float] = {}
+    counters: dict[str, int] = {}
+    for r in records:
+        rel_ms = (r["mono"] - t0) * 1e3
+        fields = {
+            k: v
+            for k, v in r.items()
+            if k not in ("seq", "t", "mono", "type")
+        }
+        if r["type"] == "phase_end":
+            sec = fields.get("seconds")
+            if isinstance(sec, (int, float)):
+                phase_s[fields.get("phase", "?")] = (
+                    phase_s.get(fields.get("phase", "?"), 0.0) + sec
+                )
+            continue
+        if r["type"] == "phase_start":
+            continue
+        if r["type"] in ("job_done", "job_failed"):
+            c = fields.pop("counters", None)
+            if isinstance(c, dict):
+                counters = c
+        kv = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+        lines.append(f"  {rel_ms:10.1f} ms  {r['type']:<18} {kv}".rstrip())
+    if phase_s:
+        lines.append("phases:")
+        for k, v in sorted(phase_s.items()):
+            lines.append(f"  {k:<14} {v * 1e3:10.3f} ms")
+    if counters:
+        lines.append("counters:")
+        for k, v in sorted(counters.items()):
+            desc = COUNTERS.get(k, "")
+            lines.append(f"  {k:<26} {v:>10}  {desc}".rstrip())
+    return "\n".join(lines) + "\n"

@@ -30,6 +30,12 @@ class Metrics:
     counters: dict[str, int] = dataclasses.field(
         default_factory=lambda: defaultdict(int)
     )
+    #: Optional `utils.events.EventLog`: when attached, every site that
+    #: already threads a Metrics can journal typed events via `event` —
+    #: the one hook that reaches all execution modes without new plumbing.
+    journal: object | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
     _lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False
     )
@@ -41,6 +47,11 @@ class Metrics:
     def bump(self, counter: str, by: int = 1) -> None:
         with self._lock:
             self.counters[counter] += by
+
+    def event(self, etype: str, **fields) -> None:
+        """Emit a journal event; a no-op when no journal is attached."""
+        if self.journal is not None:
+            self.journal.emit(etype, **fields)
 
     def total_s(self) -> float:
         return sum(self.phase_s.values())
@@ -76,9 +87,12 @@ class PhaseTimer:
     def phase(self, name: str):
         from dsort_tpu.utils.tracing import annotate
 
+        self.metrics.event("phase_start", phase=name)
         t0 = time.perf_counter()
         try:
             with annotate(f"dsort:{name}"):
                 yield
         finally:
-            self.metrics.add(name, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.metrics.add(name, dt)
+            self.metrics.event("phase_end", phase=name, seconds=round(dt, 6))

@@ -1,0 +1,108 @@
+"""The driver-artifact contract: the bench's FINAL line must fit the
+driver's bounded (2,000-byte) tail capture (VERDICT r5 missing #1 — the r5
+full summary grew past it and `parsed` came back null).
+"""
+
+import importlib.util
+import json
+import os
+
+_BENCH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
+)
+_spec = importlib.util.spec_from_file_location("dsort_bench", _BENCH)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _fake_emitted(n_metrics: int) -> list:
+    """A suite-shaped _EMITTED with realistic long names and extras."""
+    names = [
+        "sort_throughput_int32_16777216_keys_single_chip_tpu",
+        "sort_throughput_int32_16777216_keys_single_chip_tpu_lax_kernel",
+        "sort_throughput_int32_67108864_keys_single_chip_tpu",
+        "sort_throughput_int64_8388608_keys_single_chip_tpu",
+        "sort_throughput_int64_8388608_keys_single_chip_tpu_lax_kernel",
+        "int64_block_vs_lax_ratio_8388608",
+        "terasort_local_phase_4194304_records_kv",
+        "merge_phase_8x131072_sorted_runs",
+        "transfer_probe_link",
+        "config1_reference_workload_16384_int32",
+        "config2_uniform_1M_int32_spmd",
+        "config3_uniform_1M_int64_spmd",
+        "config4_terasort_65536_records_kv",
+        "config5_zipf_1M_with_injected_failure",
+        "config5_zipf_1M_injected_failure_8dev_cpu_mesh",
+        "spmd_sort_1M_end_to_end_phase_split",
+        "spmd_sort_2p26_end_to_end_phase_split",
+        "spmd_sort_1M_phase_split_8dev_cpu_mesh",
+        "tunnel_drift_sensor_lax_int32",
+        "sort_throughput_int32_4194304_keys_single_chip_cpu_fallback",
+    ]
+    while len(names) < n_metrics:
+        names.append(f"extra_capability_line_number_{len(names)}_keys")
+    out = []
+    for i, name in enumerate(names[:n_metrics]):
+        line = {
+            "metric": name,
+            "value": round(1.234e9 / (i + 1), 1),
+            "unit": "keys/sec",
+            "method": "chain_slope(8,48)",
+            "chained_value": round(1.1e9 / (i + 1), 1),
+            "fixed_overhead_ms_per_dispatch": 101.23,
+            "phases_seconds": {"partition": 0.1234, "assemble": 0.5678,
+                               "spmd_sort": 0.9} if "phase" in name else {},
+            "host_fraction": 0.594,
+        }
+        if i % 2 == 0:
+            line["vs_baseline"] = round(28_000.0 / (i + 1), 2)
+        out.append(line)
+    return out
+
+
+def test_compact_summary_fits_driver_tail():
+    """>= 20 metrics, compact line < 1,800 bytes (driver capture is 2,000)."""
+    emitted = _fake_emitted(20)
+    compact = bench._compact_summary(emitted)
+    encoded = json.dumps(compact)
+    assert len(encoded) < 1800, f"{len(encoded)} bytes: {encoded[:200]}..."
+    # one entry per metric — dedupe never drops a line
+    assert len(compact["l"]) == 20
+    # headline value + vs_baseline survive on the top level
+    assert compact["value"] == emitted[0]["value"]
+    assert compact["vs_baseline"] == emitted[0]["vs_baseline"]
+
+
+def test_compact_summary_keys_unique_and_stable():
+    emitted = _fake_emitted(25)
+    a = bench._compact_summary(emitted)
+    b = bench._compact_summary(emitted)
+    assert a == b  # deterministic
+    assert len(set(a["l"])) == 25
+
+
+def test_abbrev_distinguishes_dtypes_and_sizes():
+    a = bench._abbrev("sort_throughput_int32_16777216_keys_single_chip_tpu")
+    b = bench._abbrev("sort_throughput_int64_16777216_keys_single_chip_tpu")
+    c = bench._abbrev("sort_throughput_int32_67108864_keys_single_chip_tpu")
+    assert len({a, b, c}) == 3
+    assert "2p24" in a and "2p26" in c
+    assert "i64" in b
+
+
+def test_emit_summary_prints_compact_last(capsys):
+    """The LAST stdout line is the compact summary — the driver's `parsed`
+    lands exactly there."""
+    bench._EMITTED.clear()
+    try:
+        for line in _fake_emitted(20):
+            bench._EMITTED.append(line)
+        bench._emit_summary()
+    finally:
+        bench._EMITTED.clear()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2  # full summary, then compact
+    full, compact = json.loads(out[0]), json.loads(out[1])
+    assert full["metric"] == "summary"
+    assert compact["metric"] == "compact_summary"
+    assert len(out[1]) < 1800

@@ -76,6 +76,8 @@ def test_sample_sort_with_bitonic_kernel(mesh8):
     np.testing.assert_array_equal(out, np.sort(data))
 
 
+@pytest.mark.slow  # ~70 s interpreted; the bitonic-kernel twin keeps
+# the kernel-inside-sample-sort path in tier-1
 def test_sample_sort_with_pallas_kernel(mesh8):
     from dsort_tpu.data.ingest import gen_uniform
     from dsort_tpu.parallel.sample_sort import SampleSort

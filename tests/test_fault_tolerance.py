@@ -36,15 +36,30 @@ def test_healthy_job():
 def test_one_worker_killed_before_dispatch():
     # The SURVEY.md §0 kill -9 experiment: kill worker 3 pre-dispatch; the job
     # must still complete correctly with >=1 reassignment logged.
+    from dsort_tpu.utils.events import EventLog
+
     inj = FaultInjector()
     inj.kill(3)
     sched = make_sched(inj)
     data = gen_uniform(20_000, seed=2)
-    m = Metrics()
+    journal = EventLog()
+    m = Metrics(journal=journal)
     out = sched.run_job(data, metrics=m)
     np.testing.assert_array_equal(out, np.sort(data))
     assert m.counters["reassignments"] >= 1
     assert not sched.table.is_alive(3)
+    # Fault timeline: kill-before-dispatch reads as
+    # worker_dead -> reassign -> job_done, in that order.
+    types = journal.types()
+    assert types[0] == "job_start" and types[-1] == "job_done"
+    assert types.index("worker_dead") < types.index("reassign") < types.index(
+        "job_done"
+    )
+    dead = [e for e in journal.events() if e.type == "worker_dead"]
+    assert any(e.fields["worker"] == 3 for e in dead)
+    # the job_done record carries the final counters for `dsort report`
+    done = journal.events()[-1]
+    assert done.fields["counters"]["reassignments"] >= 1
 
 
 def test_transient_failure_during_recv():
@@ -98,11 +113,20 @@ def test_hung_worker_detected_by_timeout():
                     compile_grace_s=0.0)
     sched = Scheduler(DeviceExecutor(injector=inj), job)
     data = gen_uniform(4_000, seed=6)
-    m = Metrics()
+    from dsort_tpu.utils.events import EventLog
+
+    journal = EventLog()
+    m = Metrics(journal=journal)
     out = sched.run_job(data, metrics=m)
     np.testing.assert_array_equal(out, np.sort(data))
     assert m.counters["heartbeat_timeouts"] >= 1
     assert not sched.table.is_alive(0)
+    # Fault timeline: the hang is a heartbeat_lapse BEFORE the death record.
+    types = journal.types()
+    assert types.index("heartbeat_lapse") < types.index("worker_dead")
+    assert types.index("worker_dead") < types.index("job_done")
+    lapse = [e for e in journal.events() if e.type == "heartbeat_lapse"][0]
+    assert lapse.fields["worker"] == 0
 
 
 def test_cold_key_slow_compile_not_killed():
@@ -164,15 +188,28 @@ def test_worker_table_first_live_linear_scan():
 
 def test_spmd_scheduler_mesh_reform(mesh8):
     # SPMD path: device 2 dies -> mesh re-forms over 7 survivors -> correct.
+    from dsort_tpu.utils.events import EventLog
+
     inj = FaultInjector()
     inj.fail_once(2, "spmd")
     sched = SpmdScheduler(job=FAST, injector=inj)
     data = gen_uniform(40_000, seed=7)
-    m = Metrics()
+    journal = EventLog()
+    m = Metrics(journal=journal)
     out = sched.sort(data, metrics=m)
     np.testing.assert_array_equal(out, np.sort(data))
     assert m.counters["mesh_reforms"] == 1
     assert len(sched.table.live_workers()) == 7
+    # Fault timeline: worker_dead -> mesh_reform -> a second attempt_start
+    # on the 7-device mesh -> job_done.
+    types = journal.types()
+    assert types[0] == "job_start" and types[-1] == "job_done"
+    assert types.index("worker_dead") < types.index("mesh_reform")
+    reform = [e for e in journal.events() if e.type == "mesh_reform"][0]
+    assert reform.fields["survivors"] == 7
+    attempts = [e for e in journal.events() if e.type == "attempt_start"]
+    assert len(attempts) == 2
+    assert attempts[1].fields["live"] == [i for i in range(8) if i != 2]
 
 
 def test_spmd_cascading_device_loss(mesh8):
@@ -392,13 +429,24 @@ def test_spmd_shuffle_range_checkpoint_partial_loss(mesh8, tmp_path):
     sched = SpmdScheduler(job=job, injector=inj)
     data = gen_uniform(40_000, seed=60)
     inj.fail_once(7, "assemble")
-    m = Metrics()
+    from dsort_tpu.utils.events import EventLog
+
+    journal = EventLog()
+    m = Metrics(journal=journal)
     out = sched.sort(data, metrics=m, job_id="rangejob")
     np.testing.assert_array_equal(out, np.sort(data))
     assert m.counters["mesh_reforms"] == 1
     assert m.counters["shuffle_ranges_restored"] == 7  # N-1 restored
     # only the lost interval re-ran: far fewer keys than the whole job
     assert 0 < m.counters["shuffle_resort_keys"] < len(data) // 2
+    # Fault timeline: persists (the 7 saved ranges) precede the death; the
+    # retry's restore precedes completion.
+    types = journal.types()
+    assert "checkpoint_persist" in types
+    assert types.index("checkpoint_persist") < types.index("worker_dead")
+    restore = [e for e in journal.events() if e.type == "checkpoint_restore"]
+    assert any(e.fields.get("kind") == "shuffle_ranges" for e in restore)
+    assert types.index("checkpoint_restore") < types.index("job_done")
 
 
 def test_spmd_shuffle_range_checkpoint_full_restore(mesh8, tmp_path):
@@ -639,7 +687,10 @@ def test_spmd_inflight_hang_detected_and_mesh_reforms(monkeypatch, mesh8):
     monkeypatch.setattr(SpmdScheduler, "_probe_device", fake_probe)
     sched = SpmdScheduler(job=HANG_FAST)
     data = gen_uniform(30_000, seed=91)
-    m = Metrics()
+    from dsort_tpu.utils.events import EventLog
+
+    journal = EventLog()
+    m = Metrics(journal=journal)
     t0 = _time.monotonic()
     out = sched.sort(data, metrics=m)
     np.testing.assert_array_equal(out, np.sort(data))
@@ -647,6 +698,21 @@ def test_spmd_inflight_hang_detected_and_mesh_reforms(monkeypatch, mesh8):
     assert m.counters["spmd_wait_timeouts"] >= 1
     assert m.counters["mesh_reforms"] >= 1
     assert not sched.table.is_alive(3)
+    # Fault timeline of the hang reap: the lapsed wait, then the probe
+    # sweep pinpointing the wedged chip, then its death and the re-form.
+    types = journal.types()
+    assert (
+        types.index("heartbeat_lapse")
+        < types.index("probe")
+        < types.index("worker_dead")
+        < types.index("mesh_reform")
+        < types.index("job_done")
+    )
+    probes = [e for e in journal.events() if e.type == "probe"]
+    assert {p.fields["worker"] for p in probes} == set(range(8))
+    assert [p.fields["ok"] for p in probes if p.fields["worker"] == 3] == [False]
+    dead = [e for e in journal.events() if e.type == "worker_dead"]
+    assert [e.fields["worker"] for e in dead] == [3]
 
 
 def test_spmd_inflight_hang_healthy_devices_retries(mesh8):

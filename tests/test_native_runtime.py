@@ -116,13 +116,30 @@ def test_coordinator_healthy_job(cluster):
 def test_coordinator_worker_killed_midjob(cluster):
     # The reference experiment: kill -9 one worker; job completes via
     # reassignment to a live worker.
+    from dsort_tpu.utils.events import EventLog
+    from dsort_tpu.utils.metrics import Metrics
+
     coord, procs = cluster
     procs[1].kill()  # actual process kill, like SURVEY.md §0
     time.sleep(0.2)
     data = np.random.default_rng(4).integers(-(2**31), 2**31 - 1, 20_000).astype(np.int32)
-    out = coord.run_job(data, num_shards=4)
+    journal = EventLog()
+    m = Metrics(journal=journal)
+    out = coord.run_job(data, num_shards=4, metrics=m)
     np.testing.assert_array_equal(out, np.sort(data))
     assert coord.num_live == 3
+    # The C++ coordinator's state transitions landed on the SAME journal:
+    # 4 joins, the killed worker's death (detected pre-dispatch here, so
+    # shards route straight to live workers — no reassign line), one
+    # attempt per shard, and every result.  (worker_join events were
+    # buffered at cluster start and drain with the first job.)
+    types = journal.types()
+    assert types.count("worker_join") == 4
+    assert "worker_dead" in types
+    assert types.count("attempt_start") >= 4
+    assert types.count("task_done") >= 4
+    dead = [e for e in journal.events() if e.type == "worker_dead"]
+    assert dead and all("worker" in e.fields for e in dead)
 
 
 def test_coordinator_socket_kill_fault_injection(cluster):
