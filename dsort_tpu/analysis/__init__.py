@@ -1,0 +1,31 @@
+"""Project-native static analysis (`dsort lint`).
+
+The fault-tolerance story rests on invariants that only ever held by
+convention: event/counter names must exist in the ``utils.events``
+registries (on BOTH sides of the Python/C++ boundary), lock-guarded state
+must stay under its lock, traced functions must be side-effect free,
+recovery paths must not swallow errors invisibly, and version-drifting JAX
+APIs must route through ``utils.compat``.  Recovery code is the least
+executed code in the tree — exactly where a convention quietly rots.  This
+package machine-checks those invariants on every PR, without running a
+cluster or touching a backend.
+
+Entry points: ``dsort lint`` (CLI), `lint_paths` (API), `all_checkers`
+(rule catalog).  See ARCHITECTURE.md "Static analysis" for the diagnostic
+code catalog and suppression syntax (``# dsort: ignore[DSxxx]``).
+"""
+
+from dsort_tpu.analysis.core import (  # noqa: F401
+    Diagnostic,
+    LintConfig,
+    load_baseline,
+    load_config,
+    write_baseline,
+)
+from dsort_tpu.analysis.engine import (  # noqa: F401
+    Checker,
+    format_json,
+    format_text,
+    lint_paths,
+)
+from dsort_tpu.analysis.checkers import all_checkers, checker_catalog  # noqa: F401

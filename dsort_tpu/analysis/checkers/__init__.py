@@ -1,0 +1,29 @@
+"""Checker registry: every project-native rule, one instance each.
+
+Adding a checker = adding a class with ``name``/``codes``/``scope``/``check``
+and listing it here; the engine, CLI, docs catalog and the lint tests pick
+it up from this one function.
+"""
+
+from __future__ import annotations
+
+from dsort_tpu.analysis.checkers.compat import CompatChecker
+from dsort_tpu.analysis.checkers.concurrency import ConcurrencyChecker
+from dsort_tpu.analysis.checkers.exceptions import ExceptionsChecker
+from dsort_tpu.analysis.checkers.registry import RegistryChecker
+from dsort_tpu.analysis.checkers.tracing import TracingChecker
+
+
+def all_checkers():
+    return [
+        RegistryChecker(),
+        ConcurrencyChecker(),
+        TracingChecker(),
+        ExceptionsChecker(),
+        CompatChecker(),
+    ]
+
+
+def checker_catalog() -> dict[str, dict[str, str]]:
+    """{checker name: {code: description}} — the documented rule set."""
+    return {c.name: dict(c.codes) for c in all_checkers()}

@@ -1,0 +1,111 @@
+"""Compat-shim enforcement: version-drifting JAX APIs route through one door.
+
+``utils/compat.py`` exists so that every API that has moved across JAX
+versions (``shard_map``'s package, the x64 switch, Pallas compiler params)
+is absorbed in ONE place.  The shim only works if nothing bypasses it — a
+raw ``from jax.experimental.shard_map import shard_map`` compiles fine on
+0.4.x and breaks on the next upgrade, and a scattered
+``jax.config.update("jax_enable_x64", ...)`` is exactly how the x64
+enablement ended up duplicated between the CLI and the worker shim.
+
+  DS501  direct ``jax.config.update("jax_enable_x64", ...)`` outside the
+         compat module (use ``utils.compat.set_x64`` / ``enable_x64``)
+  DS502  raw ``shard_map`` import/use outside the compat module (import it
+         from ``dsort_tpu.utils.compat``)
+
+Reads (``jax.config.jax_enable_x64``) are fine — only mutation must be
+centralized.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dsort_tpu.analysis.core import Diagnostic
+from dsort_tpu.analysis.engine import Checker, FileContext
+
+_COMPAT_SUFFIX = "utils/compat.py"
+
+
+class CompatChecker(Checker):
+    name = "compat"
+    codes = {
+        "DS501": "jax_enable_x64 toggled outside utils/compat.py",
+        "DS502": "raw shard_map import outside utils/compat.py",
+    }
+    scope = ("*.py",)
+
+    def check(self, ctx: FileContext) -> list[Diagnostic]:
+        if ctx.relpath.endswith(_COMPAT_SUFFIX):
+            return []  # the shim itself is the one allowed call site
+        out: list[Diagnostic] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                # <anything>.config.update(...) AND bare config.update(...)
+                # (`from jax import config`) — the bypass form.
+                recv_is_config = isinstance(f, ast.Attribute) and (
+                    (
+                        isinstance(f.value, ast.Attribute)
+                        and f.value.attr == "config"
+                    )
+                    or (
+                        isinstance(f.value, ast.Name)
+                        and f.value.id == "config"
+                    )
+                )
+                if (
+                    recv_is_config
+                    and f.attr == "update"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "jax_enable_x64"
+                ):
+                    out.append(
+                        Diagnostic(
+                            ctx.relpath, node.lineno, node.col_offset,
+                            "DS501",
+                            "toggle x64 via dsort_tpu.utils.compat.set_x64/"
+                            "enable_x64, not jax.config.update — the shim "
+                            "is the single place that tracks this API",
+                        )
+                    )
+            elif isinstance(node, (ast.ImportFrom, ast.Import)):
+                if isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    names = {a.name for a in node.names}
+                    raw = (
+                        mod == "jax.experimental.shard_map"
+                        or (mod == "jax" and "shard_map" in names)
+                        or (mod == "jax.experimental" and "shard_map" in names)
+                    )
+                else:  # `import jax.experimental.shard_map [as x]`
+                    raw = any(
+                        a.name == "jax.experimental.shard_map"
+                        for a in node.names
+                    )
+                if raw:
+                    out.append(
+                        Diagnostic(
+                            ctx.relpath, node.lineno, node.col_offset,
+                            "DS502",
+                            "import shard_map from dsort_tpu.utils.compat "
+                            "(absorbs the check_vma/check_rep API split), "
+                            "not from jax directly",
+                        )
+                    )
+            elif isinstance(node, ast.Attribute):
+                if (
+                    node.attr == "shard_map"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"
+                ):
+                    out.append(
+                        Diagnostic(
+                            ctx.relpath, node.lineno, node.col_offset,
+                            "DS502",
+                            "use dsort_tpu.utils.compat.shard_map, not "
+                            "jax.shard_map (absent on jax 0.4.x)",
+                        )
+                    )
+        return out

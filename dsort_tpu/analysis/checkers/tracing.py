@@ -1,0 +1,330 @@
+"""Tracing-hygiene checker for jit / shard_map / Pallas code.
+
+A side effect inside a traced function does not do what its author meant:
+it fires once at TRACE time (then never again, however many times the
+compiled program runs), or — for journal emits — records an event that
+claims a device did work it may never do.  The rebuild's event journal makes
+this an easy trap: ``metrics.event`` is one attribute access away from any
+function, and under ``jit`` it would silently journal at compile time.
+
+The checker builds each module's TRACED SET — functions decorated with
+``jit`` (including ``functools.partial(jax.jit, ...)``), functions passed to
+``jit``/``shard_map``/``pallas_call`` (directly, via a local alias, or
+wrapped in ``functools.partial``), lambdas traced inline, plus the
+transitive closure over same-module calls — and flags:
+
+  DS301  host side effect under trace: ``print``/``open``/``input``,
+         ``time.*`` clock reads, journal/metrics emission (``.emit`` /
+         ``.bump`` / ``.event``), logging calls, host randomness
+         (``random.*`` / ``np.random.*``), or ``global``/``nonlocal``
+         declarations
+  DS302  a non-static value reaches a Pallas kernel's launch geometry: a
+         ``pallas_call`` ``grid=``/``out_shape=`` expression references a
+         parameter of the enclosing jit function that is not listed in
+         ``static_argnames`` (shapes/dtypes of traced arrays are fine —
+         they are static under jit; the VALUE of a traced scalar is not)
+
+Cross-module calls are not followed (each module is checked on its own
+terms); trace-time *configuration* shims (``utils.compat.enable_x64``) are
+deliberately not treated as side effects — they exist to steer tracing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dsort_tpu.analysis.core import Diagnostic
+from dsort_tpu.analysis.engine import Checker, FileContext
+
+#: Callee names that enter a tracing context; the first positional argument
+#: is (or resolves to) the traced callable.
+_TRACING_ENTRY = {"jit", "shard_map", "pallas_call"}
+
+#: Receiver attribute calls that emit/journal (side effects under trace).
+_EMIT_ATTRS = {"emit", "bump", "event", "ingest"}
+_LOG_ATTRS = {"debug", "info", "warning", "error", "exception", "critical"}
+_LOG_RECEIVERS = {"log", "logger", "logging"}
+_CLOCK_ATTRS = {"time", "monotonic", "perf_counter", "process_time", "sleep"}
+_BUILTIN_EFFECTS = {"print", "open", "input"}
+_STATIC_OK_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+
+def _callee_basename(func: ast.expr) -> str | None:
+    """Rightmost name of a call target: ``jax.jit`` -> ``jit``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_partial(call: ast.Call) -> bool:
+    return isinstance(call, ast.Call) and _callee_basename(call.func) == "partial"
+
+
+def _target_of(expr: ast.expr, local_aliases: dict) -> ast.expr | None:
+    """Resolve a traced-callable expression to a Name/Lambda if possible."""
+    seen = 0
+    while seen < 8:
+        seen += 1
+        if isinstance(expr, ast.Call) and _is_partial(expr):
+            if not expr.args:
+                return None
+            expr = expr.args[0]
+        elif isinstance(expr, ast.Name) and expr.id in local_aliases:
+            expr = local_aliases[expr.id]
+        else:
+            break
+    return expr if isinstance(expr, (ast.Name, ast.Lambda)) else None
+
+
+def _jit_static_names(deco_or_call: ast.Call) -> set[str] | None:
+    """``static_argnames`` of a jit decorator/call, or None if not a jit."""
+    if _callee_basename(deco_or_call.func) == "partial":
+        if not deco_or_call.args:
+            return None
+        inner = deco_or_call.args[0]
+        if _callee_basename(inner) != "jit":
+            return None
+        kws = deco_or_call.keywords
+    elif _callee_basename(deco_or_call.func) == "jit":
+        kws = deco_or_call.keywords
+    else:
+        return None
+    for kw in kws:
+        if kw.arg == "static_argnames":
+            names = set()
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+            return names
+    return set()
+
+
+class TracingChecker(Checker):
+    name = "tracing"
+    codes = {
+        "DS301": "host side effect inside a traced (jit/shard_map/pallas) "
+                 "function",
+        "DS302": "non-static value in a pallas_call grid/out_shape",
+    }
+    scope = ("*.py",)
+
+    def check(self, ctx: FileContext) -> list[Diagnostic]:
+        module_fns: dict[str, ast.FunctionDef] = {
+            n.name: n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.FunctionDef)
+        }
+        traced: dict[str, ast.FunctionDef] = {}
+        traced_lambdas: dict[int, ast.Lambda] = {}  # id() -> node: the
+        # module-wide and per-function seeding walks both reach inline
+        # lambdas; keying by node identity keeps each reported once
+        jit_statics: dict[str, set[str]] = {}
+
+        # Seed 1: decorated functions.
+        for fn in module_fns.values():
+            for deco in fn.decorator_list:
+                base = deco
+                if isinstance(deco, ast.Call):
+                    statics = _jit_static_names(deco)
+                    if statics is not None:
+                        traced[fn.name] = fn
+                        jit_statics[fn.name] = statics
+                        continue
+                    base = deco.func
+                if _callee_basename(base) in _TRACING_ENTRY:
+                    traced[fn.name] = fn
+                    jit_statics.setdefault(fn.name, set())
+
+        # Seed 2: callables handed to jit/shard_map/pallas_call anywhere.
+        # Local aliases (fn = functools.partial(F, ...)) resolve per
+        # enclosing function body.
+        def seed_calls(body_owner, local_aliases):
+            for node in ast.walk(body_owner):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _callee_basename(node.func) not in _TRACING_ENTRY:
+                    continue
+                if not node.args:
+                    continue
+                tgt = _target_of(node.args[0], local_aliases)
+                if isinstance(tgt, ast.Lambda):
+                    traced_lambdas[id(tgt)] = tgt
+                elif isinstance(tgt, ast.Name) and tgt.id in module_fns:
+                    fn = module_fns[tgt.id]
+                    traced.setdefault(tgt.id, fn)
+                    if _callee_basename(node.func) == "jit":
+                        statics = _jit_static_names(node) or set()
+                        jit_statics.setdefault(tgt.id, statics)
+
+        def simple_assigns(owner) -> dict[str, ast.expr]:
+            out: dict[str, ast.expr] = {}
+            for node in ast.walk(owner):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    out.setdefault(node.targets[0].id, node.value)
+            return out
+
+        module_aliases = {
+            t.id: n.value
+            for n in ctx.tree.body
+            if isinstance(n, ast.Assign)
+            for t in n.targets
+            if isinstance(t, ast.Name)
+        }
+        seed_calls(ctx.tree, module_aliases)
+        for fn in module_fns.values():
+            # Re-seed with the function's OWN aliases so a local
+            # `f = functools.partial(shard_fn, ...)` resolves correctly even
+            # when another function reuses the name for something else.
+            seed_calls(fn, {**module_aliases, **simple_assigns(fn)})
+
+        # Transitive closure over same-module calls from traced bodies.
+        work = list(traced.values())
+        while work:
+            fn = work.pop()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name
+                ):
+                    callee = module_fns.get(node.func.id)
+                    if callee is not None and callee.name not in traced:
+                        traced[callee.name] = callee
+                        work.append(callee)
+
+        diags: list[Diagnostic] = []
+        for name, fn in traced.items():
+            diags.extend(self._effects(ctx, fn, f"traced function {name!r}"))
+            diags.extend(
+                self._pallas_geometry(ctx, fn, jit_statics.get(name))
+            )
+        for lam in traced_lambdas.values():
+            diags.extend(self._effects(ctx, lam, "traced lambda"))
+        return diags
+
+    def _effects(self, ctx, fn, label) -> list[Diagnostic]:
+        out = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+                out.append(
+                    Diagnostic(
+                        ctx.relpath, node.lineno, node.col_offset, "DS301",
+                        f"{kind} state mutation inside {label} runs at trace "
+                        "time, not per execution",
+                    )
+                )
+            if not isinstance(node, ast.Call):
+                continue
+            what = self._effect_call(node)
+            if what is not None:
+                out.append(
+                    Diagnostic(
+                        ctx.relpath, node.lineno, node.col_offset, "DS301",
+                        f"{what} inside {label} fires once at trace time "
+                        "(and journals compile-time state, not execution)",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _effect_call(node: ast.Call) -> str | None:
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _BUILTIN_EFFECTS:
+            return f"call to {f.id}()"
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        recv_name = recv.id if isinstance(recv, ast.Name) else None
+        if recv_name == "time" and f.attr in _CLOCK_ATTRS:
+            return f"clock read time.{f.attr}()"
+        if f.attr in _EMIT_ATTRS and recv_name != "self":
+            # metrics.event / journal.emit / metrics.bump — journaling.
+            return f"journal emission .{f.attr}()"
+        if recv_name in _LOG_RECEIVERS and f.attr in _LOG_ATTRS:
+            return f"logging call {recv_name}.{f.attr}()"
+        if recv_name == "random":
+            return f"host randomness random.{f.attr}()"
+        if (
+            isinstance(recv, ast.Attribute)
+            and recv.attr == "random"
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id in ("np", "numpy")
+        ):
+            return f"host randomness {recv.value.id}.random.{f.attr}()"
+        return None
+
+    def _pallas_geometry(self, ctx, fn, statics) -> list[Diagnostic]:
+        """DS302: pallas_call grid/out_shape using a non-static parameter."""
+        if statics is None or isinstance(fn, ast.Lambda):
+            return []  # only meaningful when the jit static set is known
+        params = {
+            a.arg
+            for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)
+        }
+        simple_locals: dict[str, ast.expr] = {}
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                simple_locals.setdefault(node.targets[0].id, node.value)
+        out = []
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and _callee_basename(node.func) == "pallas_call"
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg not in ("grid", "out_shape"):
+                    continue
+                for name_node in self._value_names(kw.value, simple_locals):
+                    if name_node.id in params and name_node.id not in statics:
+                        out.append(
+                            Diagnostic(
+                                ctx.relpath, name_node.lineno,
+                                name_node.col_offset, "DS302",
+                                f"pallas_call {kw.arg}= uses parameter "
+                                f"{name_node.id!r}, which is traced (not in "
+                                "static_argnames) — kernel geometry must be "
+                                "static",
+                            )
+                        )
+        return out
+
+    def _value_names(self, expr: ast.expr, simple_locals, depth=0):
+        """Names whose runtime VALUE feeds ``expr``.
+
+        Two exclusions keep this honest: ``x.shape``/``x.dtype`` accessors
+        are static under jit, and names passed as arguments to helper CALLS
+        (``out_shape=_shapes(xs)``) are assumed shape-only plumbing — except
+        for ``ShapeDtypeStruct(...)``, whose arguments ARE the geometry and
+        stay checked.  One level of simple-local resolution.
+        """
+        static_bases: set[int] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute) and node.attr in _STATIC_OK_ATTRS:
+                for inner in ast.walk(node.value):
+                    static_bases.add(id(inner))
+            elif (
+                isinstance(node, ast.Call)
+                and _callee_basename(node.func) != "ShapeDtypeStruct"
+            ):
+                for sub in node.args + [kw.value for kw in node.keywords]:
+                    for inner in ast.walk(sub):
+                        static_bases.add(id(inner))
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Name) or id(node) in static_bases:
+                continue
+            if depth < 1 and node.id in simple_locals:
+                yield from self._value_names(
+                    simple_locals[node.id], simple_locals, depth + 1
+                )
+            else:
+                yield node

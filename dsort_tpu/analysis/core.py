@@ -1,0 +1,195 @@
+"""Lint-engine core: diagnostics, suppressions, baseline, configuration.
+
+The pieces every checker shares.  A `Diagnostic` is one finding — stable
+``code`` (``DSxxx``), severity, file/line/column span, message.  Suppression
+is per-line (``# dsort: ignore[DS201]`` in Python, ``// dsort: ignore[...]``
+in C++ — bare ``ignore`` silences every code on that line).  The baseline
+file records findings that are tolerated for now; matching deliberately
+ignores line numbers so unrelated edits above a baselined site do not
+resurrect it.  The shipped tree keeps the baseline EMPTY — it exists so a
+future emergency has an escape hatch that is visible in review, not so
+violations can accumulate silently.
+
+Everything in the analysis package is stdlib-only (``ast``, ``tomllib``,
+``json``): linting a tree never touches a JAX backend or device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+#: Severity levels, in increasing order of badness.  ``error`` fails the
+#: lint run; ``warning`` is reported but does not affect the exit code.
+SEVERITIES = ("warning", "error")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Diagnostic:
+    """One finding: a stable code anchored to a source span."""
+
+    path: str  # repo-relative, '/'-separated (stable across platforms)
+    line: int  # 1-based
+    col: int  # 0-based (ast convention)
+    code: str  # "DS101" etc. — see the checker catalog in ARCHITECTURE.md
+    message: str
+    severity: str = "error"
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col + 1}: "
+            f"{self.code} [{self.severity}] {self.message}"
+        )
+
+    @property
+    def baseline_key(self) -> tuple:
+        """Line-independent identity: edits above a site must not churn the
+        baseline, so only (path, code, message) participate."""
+        return (self.path, self.code, self.message)
+
+
+# -- suppression comments ---------------------------------------------------
+
+#: ``# dsort: ignore`` or ``# dsort: ignore[DS101,DS202]`` (Python), same
+#: after ``//`` in C++.  Matching is per physical line.
+_SUPPRESS_RE = re.compile(
+    r"(?:#|//)\s*dsort:\s*ignore(?:\[(?P<codes>[A-Z0-9, ]+)\])?"
+)
+
+
+def suppressions(source: str) -> dict[int, set[str] | None]:
+    """Map of 1-based line -> suppressed codes (None = all codes)."""
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        codes = m.group("codes")
+        out[i] = (
+            None
+            if codes is None
+            else {c.strip() for c in codes.split(",") if c.strip()}
+        )
+    return out
+
+
+def is_suppressed(diag: Diagnostic, supp: dict[int, set[str] | None]) -> bool:
+    codes = supp.get(diag.line, ...)
+    if codes is ...:
+        return False
+    return codes is None or diag.code in codes
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def load_baseline(path: str | None) -> set[tuple]:
+    """Baseline keys from a JSON file (missing file = empty baseline)."""
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {
+        (e["path"], e["code"], e["message"])
+        for e in data.get("entries", [])
+    }
+
+def write_baseline(path: str, diags: list[Diagnostic]) -> None:
+    entries = [
+        {"path": d.path, "code": d.code, "message": d.message}
+        for d in sorted(diags)
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1)
+        f.write("\n")
+
+
+# -- configuration ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Engine configuration (the ``[tool.dsort.lint]`` pyproject table).
+
+    ``root`` anchors every relative path: scope globs match against
+    root-relative file paths, and ``registry_path``/``native_map_path``
+    default to the project's own registry sources so the registry checker
+    reads THE vocabulary, not a copy.
+    """
+
+    root: str = "."
+    enable: tuple[str, ...] = ()  # empty = every registered checker
+    baseline: str | None = None
+    registry_path: str = os.path.join("dsort_tpu", "utils", "events.py")
+    native_map_path: str = os.path.join("dsort_tpu", "runtime", "native.py")
+
+    def abspath(self, rel: str | None) -> str | None:
+        if rel is None:
+            return None
+        return rel if os.path.isabs(rel) else os.path.join(self.root, rel)
+
+
+def _read_lint_table(path: str) -> dict:
+    """The ``[tool.dsort.lint]`` table of a pyproject.toml.
+
+    Uses ``tomllib`` when available (3.11+); on 3.10 falls back to a
+    section-scoped reader that handles exactly the value shapes this table
+    uses (strings and string arrays) — no dependency may be added for this.
+    """
+    try:
+        import tomllib
+    except ImportError:
+        tomllib = None
+    if tomllib is not None:
+        with open(path, "rb") as f:
+            return (
+                tomllib.load(f).get("tool", {}).get("dsort", {}).get("lint", {})
+            )
+    table: dict = {}
+    in_section = False
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if line.startswith("["):
+                in_section = line == "[tool.dsort.lint]"
+                continue
+            if not in_section or "=" not in line or line.startswith("#"):
+                continue
+            key, _, val = line.partition("=")
+            key, val = key.strip(), val.strip()
+            if val.startswith("["):
+                table[key] = re.findall(r'"([^"]*)"', val)
+            elif val.startswith('"'):
+                table[key] = val.strip('"')
+    return table
+
+
+def load_config(root: str) -> LintConfig:
+    """Read ``[tool.dsort.lint]`` from ``<root>/pyproject.toml`` (absent
+    file or table = defaults)."""
+    cfg = LintConfig(root=root)
+    py = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(py):
+        return cfg
+    table = _read_lint_table(py)
+    if "enable" in table:
+        cfg.enable = tuple(table["enable"])
+    if "baseline" in table:
+        cfg.baseline = table["baseline"]
+    if "registry" in table:
+        cfg.registry_path = table["registry"]
+    if "native_map" in table:
+        cfg.native_map_path = table["native_map"]
+    return cfg

@@ -1,0 +1,222 @@
+"""The lint engine: file discovery, checker dispatch, output formats.
+
+`lint_paths` walks the requested files/directories, parses each Python file
+once, hands every file to the checkers whose scope matches, and filters the
+findings through per-line suppressions and the baseline.  Checkers are
+plain classes registered in `dsort_tpu.analysis.checkers`; the engine knows
+nothing about individual rules.
+
+The project registries (event types / counters in ``utils/events.py``, the
+native event map in ``runtime/native.py``) are read by PARSING their source,
+not importing it: the linter must see exactly what is written in the tree it
+checks (an out-of-date installed copy must not mask drift), and checking a
+tree must never initialize a JAX backend.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+
+from dsort_tpu.analysis.core import (
+    Diagnostic,
+    LintConfig,
+    is_suppressed,
+    load_baseline,
+    suppressions,
+)
+
+
+class FileContext:
+    """Everything checkers may need about one file, parsed once."""
+
+    def __init__(self, path: str, relpath: str, source: str, config: LintConfig):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.config = config
+        self.is_python = relpath.endswith(".py")
+        self.tree: ast.AST | None = None
+        if self.is_python:
+            self.tree = ast.parse(source, filename=path)
+
+
+class Checker:
+    """Base class: subclasses set `name`, `codes`, `scope`, and `check`.
+
+    ``scope`` is a tuple of fnmatch globs over repo-relative paths; the
+    engine only hands a checker files it matches.  ``codes`` documents every
+    diagnostic the checker can produce (the catalog rendered in
+    ARCHITECTURE.md and enforced by tests).
+    """
+
+    name: str = ""
+    codes: dict[str, str] = {}
+    scope: tuple[str, ...] = ("*.py",)
+
+    def __init__(self, scope: tuple[str, ...] | None = None):
+        # Tests point a checker at fixture trees outside its default scope.
+        if scope is not None:
+            self.scope = tuple(scope)
+
+    def matches(self, relpath: str) -> bool:
+        rel = relpath.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(rel, pat) for pat in self.scope)
+
+    def check(self, ctx: FileContext) -> list[Diagnostic]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# -- project registries, read statically ------------------------------------
+
+
+def _dict_literal_keys(tree: ast.AST, names: set[str]) -> dict[str, list[str]]:
+    """String keys of top-level dict literals assigned to ``names``.
+
+    Matches both plain and annotated assignments (``X: dict[str, str] = {}``).
+    """
+    out: dict[str, list[str]] = {}
+    for node in ast.walk(tree):
+        targets: list[ast.expr] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if (
+                isinstance(t, ast.Name)
+                and t.id in names
+                and isinstance(value, ast.Dict)
+            ):
+                out[t.id] = [
+                    k.value
+                    for k in value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                ]
+    return out
+
+
+class Registries:
+    """Lazily parsed project vocabularies shared by the registry checkers."""
+
+    def __init__(self, config: LintConfig):
+        self._config = config
+        self._loaded = False
+        self.event_types: set[str] = set()
+        self.counters: set[str] = set()
+        self.native_map: set[str] = set()  # native line names the parser maps
+        self.missing: list[str] = []  # registry files that could not be read
+
+    def load(self) -> "Registries":
+        if self._loaded:
+            return self
+        self._loaded = True
+        reg = self._config.abspath(self._config.registry_path)
+        if reg and os.path.exists(reg):
+            with open(reg, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=reg)
+            found = _dict_literal_keys(tree, {"EVENT_TYPES", "COUNTERS"})
+            self.event_types = set(found.get("EVENT_TYPES", []))
+            self.counters = set(found.get("COUNTERS", []))
+        else:
+            self.missing.append(self._config.registry_path)
+        nat = self._config.abspath(self._config.native_map_path)
+        if nat and os.path.exists(nat):
+            with open(nat, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=nat)
+            found = _dict_literal_keys(tree, {"_COORD_EVENT_TYPES"})
+            self.native_map = set(found.get("_COORD_EVENT_TYPES", []))
+        else:
+            self.missing.append(self._config.native_map_path)
+        return self
+
+
+# -- the run ----------------------------------------------------------------
+
+_LINTABLE = (".py", ".cpp", ".cc", ".h", ".hpp")
+_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules"}
+
+
+def discover(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, names in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+                for name in sorted(names):
+                    if name.endswith(_LINTABLE):
+                        files.append(os.path.join(dirpath, name))
+        elif p.endswith(_LINTABLE):
+            files.append(p)
+    return files
+
+
+def lint_paths(
+    paths: list[str],
+    config: LintConfig | None = None,
+    checkers: list[Checker] | None = None,
+) -> list[Diagnostic]:
+    """Run ``checkers`` (default: all registered, minus config disables)
+    over ``paths``; returns baseline- and suppression-filtered diagnostics
+    sorted by (path, line, col, code)."""
+    from dsort_tpu.analysis.checkers import all_checkers
+
+    config = config or LintConfig()
+    if checkers is None:
+        checkers = all_checkers()
+        if config.enable:
+            known = {c.name for c in checkers}
+            unknown = sorted(set(config.enable) - known)
+            if unknown:
+                # A typo'd name would silently disable a checker and let
+                # the gate pass vacuously — same doctrine as the CLI's
+                # missing-path error.
+                raise ValueError(
+                    f"[tool.dsort.lint] enable names unknown checkers "
+                    f"{unknown}; known: {sorted(known)}"
+                )
+            checkers = [c for c in checkers if c.name in config.enable]
+    registries = Registries(config)
+    baseline = load_baseline(config.abspath(config.baseline))
+    diags: list[Diagnostic] = []
+    for path in discover(paths):
+        rel = os.path.relpath(path, config.root)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        try:
+            ctx = FileContext(path, rel, source, config)
+        except SyntaxError as e:
+            diags.append(
+                Diagnostic(
+                    rel.replace(os.sep, "/"), e.lineno or 1, 0, "DS001",
+                    f"syntax error: {e.msg}",
+                )
+            )
+            continue
+        ctx.registries = registries  # shared lazily-loaded vocabularies
+        supp = suppressions(source)
+        for checker in checkers:
+            if not checker.matches(rel):
+                continue
+            for d in checker.check(ctx):
+                if not is_suppressed(d, supp) and d.baseline_key not in baseline:
+                    diags.append(d)
+    # Identical findings collapse (Diagnostic is frozen/hashable): run-wide
+    # diagnostics like DS105 anchor on a shared path and report once.
+    return sorted(set(diags), key=lambda d: (d.path, d.line, d.col, d.code))
+
+
+def format_text(diags: list[Diagnostic]) -> str:
+    lines = [d.format() for d in diags]
+    errors = sum(d.severity == "error" for d in diags)
+    lines.append(
+        f"dsort lint: {errors} error(s), {len(diags) - errors} warning(s)"
+    )
+    return "\n".join(lines) + "\n"
+
+
+def format_json(diags: list[Diagnostic]) -> str:
+    return json.dumps([d.to_dict() for d in diags], indent=1) + "\n"

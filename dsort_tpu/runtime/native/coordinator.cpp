@@ -55,6 +55,26 @@ double now_s() {
       .count();
 }
 
+// cv.wait_for via a system-clock deadline.  libstdc++ >= 10 lowers wait_for
+// to pthread_cond_clockwait (CLOCK_MONOTONIC), which older ThreadSanitizer
+// runtimes (gcc 10's libtsan among them) do not intercept — TSan then
+// misses the wait's internal unlock and reports phantom "double lock of a
+// mutex" plus cascading data races on everything mu_ guards, drowning real
+// findings.  wait_until on system_clock takes the intercepted
+// pthread_cond_timedwait path everywhere.  Trade-off: a wall-clock jump
+// during the wait shifts the deadline; every use here is a liveness
+// timeout where that is benign.
+template <typename Pred>
+bool wait_for_s(std::condition_variable& cv, std::unique_lock<std::mutex>& lk,
+                double seconds, Pred pred) {
+  return cv.wait_until(
+      lk,
+      std::chrono::system_clock::now() +
+          std::chrono::duration_cast<std::chrono::system_clock::duration>(
+              std::chrono::duration<double>(seconds)),
+      pred);
+}
+
 struct Worker {
   int fd = -1;
   bool alive = false;
@@ -108,8 +128,8 @@ class Coordinator {
 
   int wait_workers(int n, double timeout_s) {
     std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
-                 [&] { return total_connected_ >= n || stopping_; });
+    wait_for_s(cv_, lk, timeout_s,
+               [&] { return total_connected_ >= n || stopping_; });
     return total_connected_;
   }
 
@@ -138,12 +158,11 @@ class Coordinator {
   // (no live workers), -2 on timeout.  Result pinned to task_id.
   int64_t collect(uint32_t task_id, uint8_t* out, uint64_t cap, double timeout_s) {
     std::unique_lock<std::mutex> lk(mu_);
-    bool done = cv_.wait_for(
-        lk, std::chrono::duration<double>(timeout_s), [&] {
-          auto it = tasks_.find(task_id);
-          return it != tasks_.end() && (it->second.state == TaskState::kDone ||
-                                        it->second.state == TaskState::kFailed);
-        });
+    bool done = wait_for_s(cv_, lk, timeout_s, [&] {
+      auto it = tasks_.find(task_id);
+      return it != tasks_.end() && (it->second.state == TaskState::kDone ||
+                                    it->second.state == TaskState::kFailed);
+    });
     if (!done) return -2;
     Task& t = tasks_[task_id];
     if (t.state == TaskState::kFailed) return -1;
@@ -403,9 +422,7 @@ class Coordinator {
     while (true) {
       {
         std::unique_lock<std::mutex> lk(mu_);
-        if (cv_.wait_for(lk, std::chrono::milliseconds(200),
-                         [&] { return stopping_; }))
-          return;
+        if (wait_for_s(cv_, lk, 0.2, [&] { return stopping_; })) return;
         double t = now_s();
         for (int i = 0; i < static_cast<int>(workers_.size()); ++i) {
           Worker& w = *workers_[i];

@@ -70,8 +70,10 @@ class SortWorker:
                 # to 32-bit — the sorted result frame would come back
                 # half-length and value-truncated.  This worker is its own
                 # entrypoint (never passes through cli.main), so it must
-                # enable x64 itself.
-                jax.config.update("jax_enable_x64", True)
+                # enable x64 itself — via the compat shim (DS501).
+                from dsort_tpu.utils.compat import set_x64
+
+                set_x64(True)
             # The worker owns its kernel (client.c:140-173): ``auto`` routes
             # to the block kernel on a TPU-attached worker, lax elsewhere.
             from dsort_tpu.ops.local_sort import sort_with_kernel

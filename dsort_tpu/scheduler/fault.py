@@ -170,17 +170,22 @@ class FaultInjector:
         """Raise WorkerFailure (or stall) if an injected fault applies here."""
         with self._lock:
             hang = self._hangs.pop((worker, stage), None)
-            if hang is None:
-                if worker in self._killed:
-                    self.trips += 1
-                    raise WorkerFailure(worker, stage)
+            if hang is not None:
+                # Count the trip under the lock (`trips` is read by racing
+                # drill assertions; int += is not atomic — DS201) but stall
+                # OUTSIDE it: a hang injection must wedge only its own
+                # worker, not every thread touching the injector (DS202).
+                self.trips += 1
+            elif worker in self._killed:
+                self.trips += 1
+                raise WorkerFailure(worker, stage)
+            else:
                 left = self._one_shots.get((worker, stage), 0)
                 if left > 0:
                     self._one_shots[(worker, stage)] = left - 1
                     self.trips += 1
                     raise WorkerFailure(worker, stage)
         if hang is not None:
-            self.trips += 1
             import time
 
             time.sleep(hang)

@@ -46,3 +46,15 @@ def enable_x64(new_val: bool = True):
     from jax.experimental import enable_x64 as _enable_x64
 
     return _enable_x64(new_val)
+
+
+def set_x64(enable: bool = True) -> None:
+    """Process-wide x64 switch — THE one allowed call site.
+
+    Every entry point that needs 64-bit key dtypes (the CLI, the worker
+    shim) routes through here instead of scattering
+    ``jax.config.update("jax_enable_x64", ...)``; the analysis suite's
+    DS501 checker enforces it, so when this API next moves there is exactly
+    one line to change.
+    """
+    jax.config.update("jax_enable_x64", enable)

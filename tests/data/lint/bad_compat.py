@@ -1,0 +1,9 @@
+"""Fixture: compat-shim bypasses (DS501/DS502)."""
+
+import jax
+from jax.experimental.shard_map import shard_map  # DS502: raw import
+
+
+def setup():
+    jax.config.update("jax_enable_x64", True)  # DS501: bypasses the shim
+    return shard_map

@@ -1,0 +1,47 @@
+"""Fixture: concurrency-discipline violations (DS201/DS202/DS203)."""
+
+import threading
+import time
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+SHARED = {}
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = [0]
+
+    def guarded(self):
+        with self._lock:
+            self._state[0] = 1
+
+    def racy(self):
+        self._state[0] = 2  # DS201: guarded attribute, no lock held
+
+    def slow(self, worker):
+        with self._lock:
+            time.sleep(0.1)  # DS202: blocking while holding the lock
+            worker.join()  # DS202
+
+
+def write_shared(key):
+    with LOCK_A:
+        SHARED[key] = 1
+
+
+def write_shared_racy(key):
+    SHARED[key] = 2  # DS201: guarded module global, no lock held
+
+
+def order_ab():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def order_ba():
+    with LOCK_B:
+        with LOCK_A:  # DS203: ABBA with order_ab
+            pass

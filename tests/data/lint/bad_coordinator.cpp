@@ -1,0 +1,9 @@
+// Fixture: C++ event-vocabulary drift (DS103/DS104).
+void log_event_locked(const char* type, int w, long task);
+
+void transitions() {
+  log_event_locked("fake_native_event", 1, -1);  // DS103: unregistered
+  // "probe" IS in EVENT_TYPES but runtime/native.py's parser map does not
+  // translate it — the drained line would be silently dropped:
+  log_event_locked("probe", 1, -1);  // DS104
+}

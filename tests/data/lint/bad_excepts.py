@@ -1,0 +1,15 @@
+"""Fixture: recovery-path exception-hygiene violations (DS401/DS402)."""
+
+
+def resume(ckpt):
+    try:
+        return ckpt.load(0)
+    except:  # noqa: E722  DS401: bare except
+        pass
+
+
+def swallow(ckpt):
+    try:
+        return ckpt.load(1)
+    except Exception:  # DS402: swallowed, unreported
+        pass

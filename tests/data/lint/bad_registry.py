@@ -1,0 +1,8 @@
+"""Fixture: registry-coverage violations (DS101/DS102)."""
+
+
+def run(metrics, journal):
+    metrics.bump("bogus_counter")  # DS102: not in COUNTERS
+    metrics.event("bogus_event", n_keys=1)  # DS101: not in EVENT_TYPES
+    journal.emit("another_bogus_event")  # DS101
+    journal.ingest(1.0, 2.0, "bogus_ingested_event", worker=0)  # DS101

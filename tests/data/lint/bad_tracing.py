@@ -1,0 +1,40 @@
+"""Fixture: tracing-hygiene violations (DS301/DS302)."""
+
+import functools
+import time
+
+import jax
+
+
+@jax.jit
+def leaky(x, metrics):
+    metrics.event("job_start", n_keys=1)  # DS301: journals at trace time
+    t0 = time.time()  # DS301: clock read baked in at trace time
+    print("tracing", t0)  # DS301
+    return x
+
+
+def make_counter_bumper(counter):
+    @jax.jit
+    def bump(x):
+        nonlocal counter  # DS301: nonlocal mutation under trace
+        counter += 1
+        return x
+
+    return bump
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bad_geometry(x, n, interpret):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _kernel,
+        grid=(n,),  # DS302: n is a traced value, not static_argnames
+        out_shape=jax.ShapeDtypeStruct((n, 128), x.dtype),  # DS302
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,11 @@
+"""Fixture: near-miss twin of bad_compat — everything routes via the shim."""
+
+import jax
+
+from dsort_tpu.utils.compat import set_x64, shard_map  # the one true door
+
+
+def setup():
+    set_x64(True)
+    jax.config.update("jax_platforms", "cpu")  # different config key: fine
+    return shard_map, jax.config.jax_enable_x64  # reading the flag: fine

@@ -1,0 +1,47 @@
+"""Fixture: near-miss twin of bad_concurrency — all discipline kept."""
+
+import threading
+import time
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+SHARED = {}
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = [0]  # construction is single-threaded: not flagged
+        self._scratch = []
+
+    def guarded(self):
+        with self._lock:
+            self._state[0] = 1
+
+    def unguarded_attr(self):
+        self._scratch.append(1)  # never lock-guarded anywhere: not flagged
+
+    def sleep_after_release(self):
+        with self._lock:
+            val = self._state[0]
+        time.sleep(0.0)  # blocking AFTER the lock released: fine
+        return val
+
+    def cv_wait(self):
+        cv = threading.Condition()
+        with cv:
+            cv.wait(timeout=0.01)  # condition pattern: wait on held object
+
+
+def write_shared(key):
+    with LOCK_A:
+        SHARED[key] = 1
+
+
+def same_order_twice():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+    with LOCK_A:
+        with LOCK_B:  # consistent A->B order everywhere: fine
+            pass

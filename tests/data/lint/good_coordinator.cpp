@@ -1,0 +1,12 @@
+// Fixture: near-miss twin of bad_coordinator — clean C++ event usage.
+#include <cstdio>
+void log_event_locked(const char* type, int w, long task);
+
+void transitions() {
+  // log_event_locked("commented_out_event", 1, -1);  <- comments ignored
+  const char* s = "worker_dead mentioned in a string is not an emit";
+  /* log_event_locked("block_commented_event", 1, -1); */
+  log_event_locked("worker_dead", 1, -1);
+  log_event_locked("reassign", 1, -1);
+  std::printf("%s", s);
+}

@@ -1,0 +1,41 @@
+"""Fixture: near-miss twin of bad_excepts — every catch accounts for itself."""
+
+
+def narrow(ckpt):
+    try:
+        return ckpt.load(0)
+    except OSError:  # specific type: allowed to pass silently
+        return None
+
+
+def reported(ckpt, log):
+    try:
+        return ckpt.load(1)
+    except Exception as e:  # broad, but visibly reported
+        log.warning("restore failed: %s", e)
+        return None
+
+
+def reraised(ckpt):
+    try:
+        return ckpt.load(2)
+    except Exception:
+        raise
+
+
+def relayed(ckpt, box):
+    try:
+        box["r"] = ckpt.load(3)
+    except BaseException as e:  # the lane-thread error relay pattern
+        box["e"] = e
+
+
+class Holder:
+    def close(self):
+        pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # interpreter-teardown idiom: exempt
+            pass

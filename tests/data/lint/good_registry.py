@@ -1,0 +1,9 @@
+"""Fixture: near-miss twin of bad_registry — every shape here is clean."""
+
+
+def run(metrics, journal, etype):
+    metrics.bump("reassignments")  # registered counter
+    metrics.event("job_done", n_keys=1)  # registered event
+    journal.emit("worker_dead", worker=3)  # registered event
+    metrics.event(etype, n_keys=1)  # dynamic name: runtime-guarded, not lint
+    metrics.emitter("bogus_but_not_an_emit_method")  # different method name

@@ -1,0 +1,41 @@
+"""Fixture: near-miss twin of bad_tracing — host effects stay on the host."""
+
+import functools
+import time
+
+import jax
+
+
+@jax.jit
+def pure(x):
+    return x * 2
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def _shapes(x):
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "interpret"))
+def good_geometry(x, rows, interpret):
+    from jax.experimental import pallas as pl
+
+    total = x.shape[0] // rows  # shapes are static under jit
+    return pl.pallas_call(
+        _kernel,
+        grid=(total,),  # static: shape arithmetic + static_argnames
+        out_shape=_shapes(x),  # helper call: shape-only plumbing
+        interpret=interpret,
+    )(x)
+
+
+def host_driver(data, metrics):
+    # NOT traced: journaling and timing on the host path are the point.
+    t0 = time.time()
+    metrics.event("job_start", n_keys=len(data))
+    out = pure(data)
+    metrics.event("job_done", n_keys=len(data))
+    return out, time.time() - t0

@@ -1,0 +1,7 @@
+"""Fixture: in-line suppressions silence exactly the named codes."""
+
+
+def run(metrics):
+    metrics.bump("bogus_counter")  # dsort: ignore[DS102]
+    metrics.bump("second_bogus_counter")  # dsort: ignore
+    metrics.event("bogus_event")  # dsort: ignore[DS999] -- wrong code: fires

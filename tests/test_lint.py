@@ -1,0 +1,369 @@
+"""Static-analysis suite tests (`dsort_tpu.analysis` / `dsort lint`).
+
+Per checker: a fixture with deliberate violations must produce exactly the
+expected codes (true-positive), and its near-miss clean twin must produce
+none (false-positive guard).  Then the engine plumbing — suppressions,
+baseline, JSON output, config — and the CI gates: the shipped tree lints
+clean with an EMPTY baseline, and vocabulary drift seeded on either side of
+the Python/C++ boundary is caught without running any cluster.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from dsort_tpu.analysis import (
+    LintConfig,
+    lint_paths,
+    load_config,
+    write_baseline,
+)
+from dsort_tpu.analysis.checkers import all_checkers, checker_catalog
+from dsort_tpu.analysis.checkers.exceptions import ExceptionsChecker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "lint")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def run_fixture(name: str, checkers=None):
+    cfg = LintConfig(root=REPO)
+    return lint_paths([fixture(name)], cfg, checkers=checkers)
+
+
+def codes_of(diags) -> list[str]:
+    return [d.code for d in diags]
+
+
+# -- per-checker true positives + clean twins -------------------------------
+
+
+def test_registry_checker_fixture():
+    assert codes_of(run_fixture("bad_registry.py")) == [
+        "DS102", "DS101", "DS101", "DS101",
+    ]
+    assert run_fixture("good_registry.py") == []
+
+
+def test_concurrency_checker_fixture():
+    diags = run_fixture("bad_concurrency.py")
+    assert sorted(codes_of(diags)) == [
+        "DS201", "DS201", "DS202", "DS202", "DS203",
+    ]
+    # the ABBA report points at the inner acquisition of the reversed order
+    abba = [d for d in diags if d.code == "DS203"][0]
+    assert "both orders" in abba.message
+    assert run_fixture("good_concurrency.py") == []
+
+
+def test_tracing_checker_fixture():
+    diags = run_fixture("bad_tracing.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS301": 4, "DS302": 2}
+    assert run_fixture("good_tracing.py") == []
+
+
+def test_exceptions_checker_fixture():
+    # Fixtures live outside the checker's recovery-path scope: rescope.
+    scoped = [ExceptionsChecker(scope=("*.py",))]
+    assert codes_of(run_fixture("bad_excepts.py", checkers=scoped)) == [
+        "DS401", "DS402",
+    ]
+    assert run_fixture("good_excepts.py", checkers=scoped) == []
+
+
+def test_compat_checker_fixture():
+    assert sorted(codes_of(run_fixture("bad_compat.py"))) == [
+        "DS501", "DS502",
+    ]
+    assert run_fixture("good_compat.py") == []
+
+
+def test_cpp_registry_fixture():
+    diags = run_fixture("bad_coordinator.cpp")
+    assert codes_of(diags) == ["DS103", "DS104"]
+    assert "fake_native_event" in diags[0].message
+    assert "probe" in diags[1].message  # registered, but unparseable on drain
+    assert run_fixture("good_coordinator.cpp") == []
+
+
+# -- engine plumbing --------------------------------------------------------
+
+
+def test_suppression_comments():
+    diags = run_fixture("suppressed.py")
+    # DS102 suppressed by code; second line suppressed wholesale; the
+    # mis-coded ignore[DS999] suppresses nothing.
+    assert codes_of(diags) == ["DS101"]
+
+
+def test_baseline_round_trip(tmp_path):
+    cfg = LintConfig(root=REPO)
+    diags = lint_paths([fixture("bad_registry.py")], cfg)
+    assert diags
+    base = tmp_path / "baseline.json"
+    write_baseline(str(base), diags)
+    cfg2 = LintConfig(root=REPO, baseline=str(base))
+    assert lint_paths([fixture("bad_registry.py")], cfg2) == []
+    # baseline keys are line-independent: the file documents (path, code,
+    # message), never line numbers
+    entries = json.loads(base.read_text())["entries"]
+    assert entries and all(set(e) == {"path", "code", "message"} for e in entries)
+
+
+def test_json_output_shape():
+    from dsort_tpu.analysis import format_json
+
+    diags = run_fixture("bad_compat.py")
+    loaded = json.loads(format_json(diags))
+    assert {d["code"] for d in loaded} == {"DS501", "DS502"}
+    assert all(
+        {"path", "line", "col", "code", "severity", "message"} <= set(d)
+        for d in loaded
+    )
+
+
+def test_config_from_pyproject():
+    cfg = load_config(REPO)
+    assert cfg.baseline == ".lint-baseline.json"
+    assert set(cfg.enable) == {c.name for c in all_checkers()}
+
+
+def test_checker_catalog_is_documented():
+    """Every checker publishes codes; every code appears in ARCHITECTURE.md
+    (the catalog the suppression syntax points suppressors at)."""
+    catalog = checker_catalog()
+    assert set(catalog) == {
+        "registry", "concurrency", "tracing", "exceptions", "compat",
+    }
+    arch = open(os.path.join(REPO, "ARCHITECTURE.md"), encoding="utf-8").read()
+    for codes in catalog.values():
+        for code in codes:
+            assert code in arch, f"{code} missing from ARCHITECTURE.md"
+
+
+def test_registry_config_error_is_loud(tmp_path):
+    cfg = LintConfig(root=str(tmp_path), registry_path="nope/events.py",
+                     native_map_path="nope/native.py")
+    src = tmp_path / "x.py"
+    src.write_text("def f(m):\n    m.bump('anything')\n")
+    diags = lint_paths([str(src)], cfg)
+    assert "DS105" in codes_of(diags)
+
+
+# -- the CI gates -----------------------------------------------------------
+
+
+def test_shipped_tree_lints_clean_with_empty_baseline():
+    """THE gate: `dsort lint dsort_tpu/` on the real tree, real pyproject
+    config, and the baseline must be shipped EMPTY."""
+    from dsort_tpu import cli
+
+    base = json.load(open(os.path.join(REPO, ".lint-baseline.json")))
+    assert base["entries"] == [], "ship the tree lint-clean, not baselined"
+    assert cli.main(["lint", "--root", REPO]) == 0
+
+
+def test_seeded_python_counter_drift_is_caught(tmp_path):
+    """A counter bumped in Python but absent from COUNTERS fails the lint
+    without running anything."""
+    pkg = tmp_path / "dsort_tpu"
+    shutil.copytree(os.path.join(REPO, "dsort_tpu"), pkg,
+                    ignore=shutil.ignore_patterns("*.so", "selftest*",
+                                                  "__pycache__"))
+    (pkg / "_seeded.py").write_text(
+        "def f(metrics):\n    metrics.bump('never_registered_counter')\n"
+    )
+    shutil.copy(os.path.join(REPO, "pyproject.toml"), tmp_path / "pyproject.toml")
+    cfg = load_config(str(tmp_path))
+    diags = lint_paths([str(pkg)], cfg)
+    assert [d for d in diags if d.code == "DS102"
+            and "never_registered_counter" in d.message]
+
+
+def test_seeded_cpp_event_drift_is_caught(tmp_path):
+    """Seeding a fake event name into coordinator.cpp is caught by the
+    registry checker (acceptance criterion — no cluster involved)."""
+    native = tmp_path / "native"
+    native.mkdir()
+    src = open(
+        os.path.join(REPO, "dsort_tpu", "runtime", "native", "coordinator.cpp"),
+        encoding="utf-8",
+    ).read()
+    assert 'log_event_locked("worker_join"' in src
+    seeded = src.replace(
+        'log_event_locked("worker_join"', 'log_event_locked("franken_event"'
+    )
+    (native / "coordinator.cpp").write_text(seeded)
+    cfg = LintConfig(root=REPO)
+    diags = lint_paths([str(native / "coordinator.cpp")], cfg)
+    assert [d for d in diags if d.code == "DS103"
+            and "franken_event" in d.message]
+
+
+def test_cli_lint_nonzero_exit_on_findings(capsys):
+    from dsort_tpu import cli
+
+    rc = cli.main(["lint", "--root", REPO, fixture("bad_compat.py")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "DS501" in out and "DS502" in out
+
+
+def test_cli_lint_runs_without_jax_backend():
+    """`dsort lint` must not initialize a JAX backend (it skips the x64
+    toggle and never touches devices) — enforced by pinning JAX_PLATFORMS
+    to a platform that CANNOT initialize: any backend touch in the lint
+    path would crash the subprocess."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "no_such_platform_lint_guard"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # site hook pins a TPU platform
+    r = subprocess.run(
+        [sys.executable, "-m", "dsort_tpu.cli", "lint", "--root", REPO],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_write_baseline_is_idempotent(tmp_path):
+    """Regenerating the baseline must keep already-tolerated findings —
+    linting THROUGH the old baseline and writing the leftovers would erase
+    them and resurrect the findings on the next run."""
+    from dsort_tpu import cli
+
+    target = tmp_path / "bad.py"
+    shutil.copy(fixture("bad_registry.py"), target)
+    base = tmp_path / "base.json"
+    assert cli.main(["lint", "--root", REPO, str(target), "--baseline",
+                     str(base), "--write-baseline"]) == 0
+    first = json.loads(base.read_text())["entries"]
+    assert len(first) == 4
+    assert cli.main(["lint", "--root", REPO, str(target), "--baseline",
+                     str(base), "--write-baseline"]) == 0
+    assert json.loads(base.read_text())["entries"] == first
+    assert cli.main(["lint", "--root", REPO, str(target), "--baseline",
+                     str(base)]) == 0  # still fully tolerated
+
+
+def test_compat_checker_bypass_forms(tmp_path):
+    """The `from jax import config` and `import jax.experimental.shard_map`
+    spellings are the same violations and must not slip through."""
+    src = tmp_path / "bypass.py"
+    src.write_text(
+        "from jax import config\n"
+        "import jax.experimental.shard_map as shard_map\n\n\n"
+        "def setup():\n"
+        "    config.update(\"jax_enable_x64\", True)\n"
+        "    return shard_map\n"
+    )
+    diags = lint_paths([str(src)], LintConfig(root=REPO))
+    assert sorted(codes_of(diags)) == ["DS501", "DS502"]
+
+
+def test_unknown_enable_name_is_loud():
+    with pytest.raises(ValueError, match="unknown checkers"):
+        lint_paths(
+            [fixture("good_registry.py")],
+            LintConfig(root=REPO, enable=("registry", "registries")),
+        )
+
+
+def test_cli_lint_missing_path_is_loud():
+    """A typo'd path must fail, never pass vacuously as '0 findings'."""
+    from dsort_tpu import cli
+
+    with pytest.raises(SystemExit, match="no such path"):
+        cli.main(["lint", "--root", REPO, "definitely/not/a/dir"])
+
+
+def test_traced_lambda_reported_once(tmp_path):
+    """The module-wide and per-function seeding walks both see an inline
+    lambda; its findings must not double-report."""
+    src = tmp_path / "lam.py"
+    src.write_text(
+        "import jax\n\n\ndef build():\n"
+        "    f = jax.jit(lambda x: print(x) or x)\n    return f\n"
+    )
+    diags = lint_paths([str(src)], LintConfig(root=REPO))
+    assert codes_of(diags) == ["DS301"]
+
+
+def test_abba_not_reported_across_distinct_class_locks(tmp_path):
+    """Two classes' same-named instance locks are DIFFERENT locks: opposite
+    nesting orders across classes are not an ABBA inversion.  Module-level
+    locks shared by both classes still are."""
+    src = tmp_path / "locks.py"
+    src.write_text(
+        "import threading\n\n"
+        "GA = threading.Lock()\nGB = threading.Lock()\n\n\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n\n"
+        "    def fwd(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n\n\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n\n"
+        "    def rev(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n\n"
+        "    def g1(self):\n"
+        "        with GA:\n"
+        "            with GB:\n"
+        "                pass\n\n\n"
+        "class C:\n"
+        "    def g2(self):\n"
+        "        with GB:\n"
+        "            with GA:\n"
+        "                pass\n"
+    )
+    diags = lint_paths([str(src)], LintConfig(root=REPO))
+    assert codes_of(diags) == ["DS203"]  # only the shared-global inversion
+    assert "GA" in diags[0].message and "GB" in diags[0].message
+
+
+# -- native event round trip (registry <-> C++ <-> drain parser) ------------
+
+
+def test_native_event_names_round_trip_registry():
+    """Every event name the C++ coordinator can emit (scanned straight out
+    of coordinator.cpp) parses through runtime/native.py's drain parser into
+    a REGISTERED journal type — asserted statically + on synthetic drain
+    lines, no cluster."""
+    from dsort_tpu.analysis.cpp_lexer import call_string_args
+    from dsort_tpu.runtime.native import _COORD_EVENT_TYPES, parse_coord_events
+    from dsort_tpu.utils.events import EVENT_TYPES, EventLog
+
+    src = open(
+        os.path.join(REPO, "dsort_tpu", "runtime", "native", "coordinator.cpp"),
+        encoding="utf-8",
+    ).read()
+    names = sorted({t.value for t in call_string_args(src, "log_event_locked")})
+    assert names, "no native events found — did the C++ scan break?"
+    lines = "".join(
+        f"t={10.0 + i:.6f} ev={name} w=0 task={i}\n"
+        for i, name in enumerate(names)
+    )
+    recs = parse_coord_events(lines)
+    # nothing dropped: every emitted name is parseable...
+    assert [r for r in recs] and len(recs) == len(names)
+    assert {r["type"] for r in recs} <= set(EVENT_TYPES)
+    # ...and ingests into a journal under registered types
+    log = EventLog()
+    for r in recs:
+        log.ingest(r["t"], r["mono"], r["type"], worker=r["worker"])
+    assert len(log) == len(names)
+    # the parser map carries no dead entries pointing outside the registry
+    assert set(_COORD_EVENT_TYPES.values()) <= set(EVENT_TYPES)

@@ -181,6 +181,37 @@ def test_native_selftest_binary():
     assert "SELFTEST PASS" in out.stdout
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("san", ["tsan", "asan", "ubsan"])
+def test_native_selftest_sanitizers(san):
+    """Sanitizer-hardened native runtime: the full selftest (threaded
+    coordinator, kills, reassignment, merges, textio) must run clean under
+    TSan/ASan/UBSan.  The instrumented binary is REBUILT from the Makefile
+    target every run — never a checked-in artifact — so the run always
+    reflects the current sources.  Any sanitizer report fails the binary
+    (TSan exits nonzero on a race; UBSan builds with
+    -fno-sanitize-recover=all)."""
+    native_dir = os.path.join(REPO, "dsort_tpu", "runtime", "native")
+    binary = os.path.join(native_dir, f"selftest_{san}")
+    if os.path.exists(binary):
+        os.remove(binary)  # stale instrumented binaries must not mask drift
+    build = subprocess.run(
+        ["make", "-C", native_dir, f"{san}-selftest"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"toolchain cannot build -fsanitize={san}: "
+                    f"{build.stderr.splitlines()[-1:]}")
+    env = dict(os.environ)
+    env.setdefault("TSAN_OPTIONS", "halt_on_error=1")
+    env.setdefault("ASAN_OPTIONS", "detect_leaks=0")  # selftest exits hot
+    out = subprocess.run(
+        [binary], capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert out.returncode == 0, f"{san} report:\n{out.stdout}\n{out.stderr}"
+    assert "SELFTEST PASS" in out.stdout
+
+
 def test_jax_worker_int64_cluster():
     """int64 keys through real jax-backend worker subprocesses.
 
