@@ -11,6 +11,11 @@ baseline:  ## record current findings as tolerated (ship this file EMPTY)
 test:  ## tier-1 suite (excludes slow/sanitizer tests)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
+bench-smoke:  ## device-resident sort + on-device validate on the 8-device cpu mesh
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m dsort_tpu.cli bench --device-resident --n 200000 --reps 2 \
+	--journal /tmp/dsort_bench_smoke.jsonl
+
 native:  ## build libdsort_native.so
 	$(MAKE) -C $(NATIVE)
 
@@ -28,4 +33,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke native tsan asan ubsan sanitize

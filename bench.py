@@ -122,7 +122,19 @@ def _emit_summary() -> None:
                   "skipped", "error"):
             if k in ln:
                 entry[k] = ln[k]
-        lines[ln["metric"]] = entry
+        # Two rows may share a metric label (e.g. ladder variants that
+        # differ only in the `kernel` extra) — keying by metric alone would
+        # silently overwrite one, the exact truncation failure mode this
+        # summary exists to prevent (ADVICE r5).  Disambiguate by kernel,
+        # then by index, so every emitted line survives into the summary.
+        key = ln["metric"]
+        if key in lines and "kernel" in ln:
+            key = f"{key}#{ln['kernel']}"
+        dup = 2
+        while key in lines:
+            key = f"{ln['metric']}#{dup}"
+            dup += 1
+        lines[key] = entry
     out = {
         "metric": "summary",
         # value/unit/vs_baseline mirror the HEADLINE line so a parser that
@@ -793,6 +805,54 @@ print(json.dumps({
                     " BENCH_r05_preview.jsonl for the measured line"
                 ),
             )
+
+    # Device-resident e2e + on-device validation (VERDICT r5 next #5): the
+    # path a real pipeline stage uses — the sorted array STAYS sharded on
+    # the mesh (`keep_on_device` -> DeviceSortResult), and `dsort validate`
+    # semantics (order + FNV multiset checksum) run as jitted shard_map
+    # reductions with only scalars crossing to the host.  The phase-split
+    # rows above measure the relay; this row is the sort.  Same 1M data as
+    # the 1M phase split, so `speedup_vs_relay_e2e` is like-for-like.
+    try:
+        from dsort_tpu.models.validate import _multiset
+
+        u1m = gen_uniform(1 << 20, seed=9)
+        h = ss.sort(u1m, keep_on_device=True)  # warm the sort program
+        h.validate_on_device()                 # warm the validator
+        st, vt = [], []
+        rep_v = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            h = ss.sort(u1m, keep_on_device=True)
+            st.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            rep_v = h.validate_on_device()
+            vt.append(time.perf_counter() - t0)
+        dt, dtv = float(min(st)), float(min(vt))
+        ok = bool(
+            rep_v.sorted_ok
+            and rep_v.records == len(u1m)
+            and rep_v.checksum == _multiset(u1m, len(u1m), u1m.dtype.itemsize)
+        )
+        extra = {}
+        if t_1m > 0:
+            extra["speedup_vs_relay_e2e"] = round(t_1m / dt, 1)
+        _emit(
+            f"sort_e2e_device_resident_1M_{chip}{suffix}",
+            (1 << 20) / dt, "keys/sec", validated_ok=ok, **extra,
+        )
+        # The on-device validate cost as its own metric: what `dsort
+        # validate` semantics cost when nothing relays to the host.
+        _emit(
+            f"validate_on_device_1M_{chip}{suffix}",
+            (1 << 20) / dtv, "keys/sec", baseline=False, validated_ok=ok,
+        )
+    except Exception as e:  # the no-relay lines must never sink the artifact
+        _emit(
+            f"sort_e2e_device_resident_1M_{chip}{suffix}", 0.0, "keys/sec",
+            baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
 
     # The same phase split on the 8-device CPU mesh, where transfers are
     # memcpy: this isolates the data plane's genuine HOST work (pad

@@ -8,6 +8,8 @@ one-shot, benchmark, data-generation, cluster, and worker entry points a real
 tool needs.
 
   dsort run INPUT [-o OUT]      one sort job (file -> file)
+  dsort run --device-resident   same, sorted array stays on the mesh and
+                                validates on device (no relay)
   dsort serve                   REPL: filenames on stdin until 'exit'
   dsort bench                   throughput benchmark, one JSON line
   dsort gen N -o FILE           synthetic inputs (uniform / zipf)
@@ -310,10 +312,76 @@ def _write_journal(journal, args) -> None:
                  args.journal, len(journal))
 
 
+def _make_device_scheduler(cfg: SortConfig):
+    """The `SpmdScheduler` behind every ``--device-resident`` entry point."""
+    from dsort_tpu.scheduler import SpmdScheduler
+
+    import jax
+
+    devs = jax.devices()
+    n = cfg.mesh.num_workers or len(devs)
+    return SpmdScheduler(devices=devs[:n], job=cfg.job)
+
+
+def _run_one_device(cfg, in_path: str, out_path: str, dtype, journal) -> int:
+    """One device-resident job: sort, validate on device, then write.
+
+    The sorted array never relays to the host for validation — the order
+    check and the FNV multiset checksum run as jitted reductions on the
+    mesh, and the permutation proof compares the device checksum against
+    the (already host-resident) input's checksum.  The single D2H is the
+    explicit ``to_host()`` that feeds the output file the `run` contract
+    requires.
+    """
+    from dsort_tpu.data.ingest import read_ints_file, write_ints_file
+    from dsort_tpu.models.validate import _multiset
+
+    if cfg.job.checkpoint_dir:
+        # Surface the semantics change up front (the scheduler's own warning
+        # only fires when a job_id reaches it): device-resident jobs do not
+        # persist ranges — a crash re-runs the whole job.
+        log.warning(
+            "--device-resident does not checkpoint: --checkpoint-dir/"
+            "--job-id are ignored; a failed job re-runs from the input"
+        )
+    sched = _make_device_scheduler(cfg)
+    t0 = time.perf_counter()
+    data = read_ints_file(in_path, dtype=dtype)
+    metrics = Metrics(journal=journal)
+    handle = sched.sort(data, metrics=metrics, keep_on_device=True)
+    rep = handle.validate_on_device()
+    in_sum = _multiset(data, len(data), data.dtype.itemsize)
+    perm_ok = rep.records == len(data) and rep.checksum == in_sum
+    write_ints_file(out_path, handle.to_host())
+    dt = time.perf_counter() - t0
+    log.info(
+        "sorted %d keys in %.1f ms (%s, device-resident) -> %s | on-device "
+        "validate: sorted=%s permutation=%s checksum=%016x | phases: %s | %s",
+        len(data), dt * 1e3, in_path, out_path, rep.sorted_ok, perm_ok,
+        rep.checksum, metrics.summary()["phases_ms"], dict(metrics.counters),
+    )
+    if not (rep.sorted_ok and perm_ok):
+        log.error("on-device validation FAILED for %s", in_path)
+        return 1
+    return 0
+
+
 def cmd_run(args) -> int:
     from dsort_tpu.utils.tracing import profile_trace
 
     cfg = _load_config(args)
+    if getattr(args, "device_resident", False):
+        if args.mode != "spmd":
+            raise SystemExit("--device-resident requires --mode spmd")
+        journal = _open_journal(args)
+        try:
+            with profile_trace(getattr(args, "profile_dir", None)):
+                return _run_one_device(
+                    cfg, args.input, args.output or cfg.output_path,
+                    np.dtype(cfg.job.key_dtype), journal,
+                )
+        finally:
+            _write_journal(journal, args)
     sorter = _make_sorter(cfg, args.mode)
     job_id = (
         _job_id_for(args.input, args.job_id) if cfg.job.checkpoint_dir else None
@@ -537,14 +605,80 @@ def _bench_suite(args) -> int:
     return 0
 
 
+def _bench_device_resident(args, cfg: SortConfig) -> int:
+    """`dsort bench --device-resident`: the no-relay e2e + validate lines.
+
+    Times (a) device-resident sort — handle creation is already
+    synchronized by the retry-scalar fetch, so the wall time is honest e2e
+    with NO key ever crossing the relay — and (b) the on-device validation
+    pass, each as its own JSON line (min over reps; one-sided jitter
+    doctrine).  This is also the `make bench-smoke` target, tier-1-gated in
+    `tests/test_device_resident.py`.
+    """
+    from dsort_tpu.data.ingest import gen_uniform
+    from dsort_tpu.models.validate import _multiset
+
+    dtype = np.dtype(cfg.job.key_dtype)
+    data = gen_uniform(args.n, dtype=dtype, seed=0)
+    sched = _make_device_scheduler(cfg)
+    journal = _open_journal(args)
+    handle = sched.sort(data, keep_on_device=True)  # warm sort program
+    handle.validate_on_device()                     # warm validator
+    sort_times, val_times = [], []
+    rep = None
+    try:
+        for _ in range(args.reps):
+            metrics = Metrics(journal=journal)
+            t0 = time.perf_counter()
+            handle = sched.sort(data, metrics=metrics, keep_on_device=True)
+            sort_times.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            rep = handle.validate_on_device()
+            val_times.append(time.perf_counter() - t0)
+    finally:
+        _write_journal(journal, args)
+    in_sum = _multiset(data, len(data), dtype.itemsize)
+    ok = bool(rep.sorted_ok and rep.records == len(data)
+              and rep.checksum == in_sum)
+    dt, dtv = float(min(sort_times)), float(min(val_times))
+    for line in (
+        {
+            "metric": f"sort_e2e_device_resident_{dtype}_{args.n}_keys",
+            "value": round(args.n / dt, 1),
+            "unit": "keys/sec",
+            "vs_baseline": round(args.n / dt / _REF_KEYS_PER_SEC, 2),
+        },
+        {
+            "metric": f"device_validate_{dtype}_{args.n}_keys",
+            "value": round(args.n / dtv, 1),
+            "unit": "keys/sec",
+            "validated_ok": ok,
+        },
+    ):
+        print(json.dumps(line), flush=True)
+    return 0 if ok else 1
+
+
 def cmd_bench(args) -> int:
     from dsort_tpu.data.ingest import gen_uniform
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if args.suite and getattr(args, "device_resident", False):
+        # The ladder has its own metric contract; silently dropping one of
+        # two explicit flags would ship an artifact missing the lines the
+        # user asked for.
+        raise SystemExit(
+            "--suite and --device-resident are separate benchmarks: run "
+            "them as two invocations"
+        )
     if args.suite:
         return _bench_suite(args)
     cfg = _load_config(args)
+    if getattr(args, "device_resident", False):
+        if args.mode != "spmd":
+            raise SystemExit("--device-resident requires --mode spmd")
+        return _bench_device_resident(args, cfg)
     sorter = _make_sorter(cfg, args.mode)
     data = gen_uniform(args.n, dtype=np.dtype(cfg.job.key_dtype), seed=0)
     journal = _open_journal(args)
@@ -969,6 +1103,10 @@ def main(argv=None) -> int:
     p.add_argument("input")
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler trace of the job here")
+    p.add_argument("--device-resident", action="store_true",
+                   help="keep the sorted array on the mesh and validate it "
+                        "on device (order + multiset checksum as jitted "
+                        "reductions); the output file write is the only D2H")
     common(p)
     p.set_defaults(fn=cmd_run)
 
@@ -982,6 +1120,9 @@ def main(argv=None) -> int:
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--suite", action="store_true",
                    help="run the BASELINE config ladder (one JSON line each)")
+    p.add_argument("--device-resident", action="store_true",
+                   help="time the no-relay path: device-resident sort + "
+                        "on-device validation, one JSON line each")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser(

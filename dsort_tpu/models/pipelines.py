@@ -60,7 +60,8 @@ def _fused_small_fn(n_pad: int, dtype_str: str, kernel: str):
 
 
 def fused_sort_small(
-    data: np.ndarray, kernel: str = "auto", metrics: Metrics | None = None
+    data: np.ndarray, kernel: str = "auto", metrics: Metrics | None = None,
+    keep_on_device: bool = False,
 ) -> np.ndarray:
     """A whole small job as ONE device program: one H2D, one execute, one D2H.
 
@@ -71,8 +72,19 @@ def fused_sort_small(
     compiled program per (pow2 size, dtype, kernel)); the pad region is
     masked to the dtype sentinel on device by `sort_padded`, so trimming to
     the input length is exact even for sentinel-valued real keys.
+
+    ``keep_on_device=True`` drops the D2H entirely: the call returns a
+    `parallel.DeviceSortResult` wrapping the padded sorted device array
+    (one shard, length ``n`` valid) without waiting on it — the next
+    consumer (``.consume``/``.validate_on_device``/``.to_host``) is the
+    completion barrier, so a small job becomes one H2D + one async execute.
     """
     data = np.asarray(data)
+    if keep_on_device and is_float_key_dtype(data.dtype):
+        raise TypeError(
+            "keep_on_device supports integer keys only; use "
+            "fused_sort_small() for floats"
+        )
     if is_float_key_dtype(data.dtype):
         return sort_float_keys_via_uint(
             lambda d, m: fused_sort_small(d, kernel, m), data, metrics
@@ -81,6 +93,19 @@ def fused_sort_small(
     timer = PhaseTimer(metrics)
     n = len(data)
     if n == 0:
+        if keep_on_device:
+            from dsort_tpu.parallel.device_result import DeviceSortResult
+
+            import jax.numpy as jnp
+
+            h = DeviceSortResult(
+                jnp.zeros((0,), dtype=data.dtype),
+                shard_lengths=np.zeros(1, np.int64), n=0, metrics=metrics,
+                label="fused",
+            )
+            metrics.bump("device_handles")
+            metrics.event("device_handle", n_keys=0, shards=1)
+            return h
         return data.copy()
     # Pad to 1/8-of-a-power-of-two granularity, not a full power of two:
     # <= 12.5% padded work at any size (a big job padded to the next pow2
@@ -91,6 +116,21 @@ def fused_sort_small(
     with timer.phase("partition"):
         buf = np.empty(n_pad, data.dtype)
         buf[:n] = data  # tail garbage is sentinel-masked on device
+    if keep_on_device:
+        from dsort_tpu.parallel.device_result import DeviceSortResult
+
+        with timer.phase("local_sort"):
+            # No fetch, no block: the handle's first consumer synchronizes.
+            out = _fused_small_fn(n_pad, str(data.dtype), kernel)(
+                buf, np.int32(n)
+            )
+        h = DeviceSortResult(
+            out, shard_lengths=np.array([n], np.int64), n=n,
+            metrics=metrics, label="fused",
+        )
+        metrics.bump("device_handles")
+        metrics.event("device_handle", n_keys=n, shards=1)
+        return h
     with timer.phase("local_sort"):
         # ONE dispatch end-to-end (VERDICT r4 next #6): the padded host
         # array feeds the jitted program directly — no jnp.asarray staging

@@ -20,6 +20,7 @@ cost the sort itself pays.
 
 from __future__ import annotations
 
+import functools
 import os
 from collections.abc import Iterator
 from dataclasses import dataclass
@@ -213,3 +214,176 @@ def checksum_ints_file(path: str | os.PathLike, dtype=np.int32) -> tuple[int, in
     the output's report to prove permutation."""
     data = read_ints_file(path, dtype=dtype)
     return len(data), _multiset(data, len(data), data.dtype.itemsize)
+
+
+# ---- device-resident validation (the no-relay valsort) --------------------
+#
+# `parallel.device_result.DeviceSortResult.validate_on_device` lands here:
+# the SAME order-check + FNV-1a multiset semantics as the streamed file
+# validators above, phrased as jitted reductions over the sorted array while
+# it is still sharded on the mesh.  Three scalars cross device->host — not
+# O(N) keys — so `dsort validate` semantics hold with no relay transfer.
+# The checksum is bit-identical to `_multiset` on the same records
+# (bitcast-to-uint8 yields each key's little-endian bytes, exactly what the
+# host hashes), so host(input) == device(output) proves the permutation.
+
+_FNV_OFFSET = 1469598103934665603  # _fnv_multiset_py's basis — MUST match
+_FNV_PRIME = 1099511628211
+
+
+def _fnv1a_u64(keys):
+    """Per-element FNV-1a over each key's little-endian bytes (traced).
+
+    Needs x64 (uint64 device arithmetic); callers check once at the API
+    boundary so the trace stays pure.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    byts = jax.lax.bitcast_convert_type(keys, jnp.uint8)
+    if byts.ndim == keys.ndim:  # itemsize 1: bitcast adds no byte dim
+        byts = byts[..., None]
+    h = jnp.full(keys.shape, np.uint64(_FNV_OFFSET), jnp.uint64)
+    prime = np.uint64(_FNV_PRIME)
+    for j in range(byts.shape[-1]):  # static byte-column sweep (<= 8)
+        h = (h ^ byts[..., j].astype(jnp.uint64)) * prime
+    return h
+
+
+def _boundary_ok(firsts, lasts, counts, p: int):
+    """Traced cross-shard order check: each nonempty shard's first key >=
+    the previous nonempty shard's last valid key.  ``p`` is static and
+    small, so the scan unrolls at trace time."""
+    import jax.numpy as jnp
+
+    ok = jnp.bool_(True)
+    have = jnp.bool_(False)
+    prev = lasts[0]
+    for i in range(p):
+        nonempty = counts[i] > 0
+        ok = ok & jnp.where(nonempty & have, firsts[i] >= prev, True)
+        prev = jnp.where(nonempty, lasts[i], prev)
+        have = have | nonempty
+    return ok
+
+
+def _rows_order_and_checksum(rows, counts):
+    """Traced core over ``(p, cap)`` sorted sentinel-padded rows: returns
+    ``(order_ok, multiset_checksum, total)`` — the plain-jit validator for
+    handles without a mesh (fused single-device results, batch job slices).
+    """
+    import jax.numpy as jnp
+
+    p, cap = rows.shape
+    pos = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    valid = pos < counts[:, None]
+    h = _fnv1a_u64(rows)
+    checksum = jnp.sum(jnp.where(valid, h, jnp.uint64(0)))
+    if cap > 1:
+        in_row_ok = ~jnp.any((rows[:, 1:] < rows[:, :-1]) & valid[:, 1:])
+    else:
+        in_row_ok = jnp.bool_(True)
+    firsts = rows[:, 0]
+    lasts = rows[jnp.arange(p), jnp.maximum(counts - 1, 0)]
+    ok = in_row_ok & _boundary_ok(firsts, lasts, counts, p)
+    return ok, checksum, jnp.sum(counts.astype(jnp.int64))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_device_validator(mesh, axis: str, cap: int, dtype_str: str):
+    """jit(shard_map(...)) order+checksum reduction for one mesh/shape combo.
+
+    Each shard checks its own run and contributes its masked FNV sum; tiny
+    boundary scalars ride one ``all_gather`` and the verdicts combine via
+    ``psum`` — every shard returns the identical (ok, checksum, total)
+    triple, so the host reads element 0 of each.  jax Meshes hash by device
+    assignment + axis names, so the cache key is exact (same rule as
+    `distributed._build_mh_program`).
+    """
+    del dtype_str  # part of the cache key; jit re-specializes by dtype
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dsort_tpu.utils.compat import shard_map
+
+    p = int(mesh.shape[axis])
+
+    def body(x, cnt):
+        cnt = cnt[0].astype(jnp.int32)
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        valid = pos < cnt
+        h = _fnv1a_u64(x)
+        local_sum = jnp.sum(jnp.where(valid, h, jnp.uint64(0)))
+        if cap > 1:
+            local_bad = jnp.any((x[1:] < x[:-1]) & valid[1:])
+        else:
+            local_bad = jnp.bool_(False)
+        first = x[0]
+        last = x[jnp.maximum(cnt - 1, 0)]
+        firsts = jax.lax.all_gather(first, axis)
+        lasts = jax.lax.all_gather(last, axis)
+        cnts = jax.lax.all_gather(cnt, axis)
+        any_bad = jax.lax.psum(local_bad.astype(jnp.int32), axis) > 0
+        ok = ~any_bad & _boundary_ok(firsts, lasts, cnts, p)
+        checksum = jax.lax.psum(local_sum, axis)
+        total = jax.lax.psum(cnt.astype(jnp.int64), axis)
+        return ok[None], checksum[None], total[None]
+
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(P(axis),) * 3,
+            check_vma=False,
+        )
+    )
+
+
+def validate_device_result(handle) -> ValidationReport:
+    """Order + multiset checksum of a `DeviceSortResult`, computed on device.
+
+    The sharded (`SampleSort`/`SpmdScheduler`) layout runs the shard_map
+    reduction over the handle's own mesh; meshless handles (fused
+    single-device results, per-job batch slices) run the same math as one
+    plain jitted reduction.  ``first_violation`` is not located on device
+    (that would cost an O(N) argmin fetch path) — it is always None; an
+    order break still reports ``sorted_ok=False``.
+    """
+    import jax
+
+    if handle.n == 0:
+        return ValidationReport(0, True, None, 0)
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "on-device validation needs 64-bit mode for the uint64 FNV "
+            "reduction: call dsort_tpu.utils.compat.set_x64(True) first"
+        )
+    p = handle.num_shards
+    data = handle._data
+    cap = data.size // p
+    if handle.mesh is not None and handle.axis is not None:
+        fn = _build_device_validator(
+            handle.mesh, handle.axis, cap, str(data.dtype)
+        )
+        counts = handle._counts_dev
+        if counts is None:
+            counts = handle.shard_lengths.astype(np.int32)
+        ok, checksum, total = jax.device_get(fn(data, counts))
+        ok, checksum, total = bool(ok[0]), int(checksum[0]), int(total[0])
+    else:
+        fn = jax.jit(_rows_order_and_checksum)
+        ok, checksum, total = jax.device_get(
+            fn(
+                data.reshape(p, cap),
+                handle.shard_lengths.astype(np.int32),
+            )
+        )
+        ok, checksum, total = bool(ok), int(checksum), int(total)
+    return ValidationReport(
+        records=total,
+        sorted_ok=ok,
+        first_violation=None,
+        checksum=checksum & _MASK64,
+    )

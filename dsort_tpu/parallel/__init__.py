@@ -5,5 +5,6 @@ from dsort_tpu.parallel.distributed import (  # noqa: F401
     sort_local_records,
     sort_local_shards,
 )
+from dsort_tpu.parallel.device_result import DeviceSortResult  # noqa: F401
 from dsort_tpu.parallel.mesh import make_mesh, local_device_mesh  # noqa: F401
 from dsort_tpu.parallel.sample_sort import BatchSampleSort, SampleSort  # noqa: F401

@@ -1,0 +1,250 @@
+"""Device-resident sort results: the no-relay end of the data plane.
+
+The r5 bench decomposition proved the on-chip e2e rows mostly measure the
+host relay, not the sort (``host_fraction`` 0.53-0.66 through the tunnel vs
+0.03-0.04 for the same code on the cpu mesh).  A pipeline stage — sort
+feeding the next jitted computation — never needs that relay at all: the
+sorted global array can stay sharded on the mesh and be consumed, validated,
+or (only when the caller really wants host bytes) fetched.
+
+`DeviceSortResult` is that contract.  Every ``keep_on_device=True`` driver
+(`SampleSort.sort`, `BatchSampleSort.sort`, `models.fused_sort_small`, and
+`scheduler.SpmdScheduler.sort`) returns one:
+
+- the sorted keys stay on device as a sentinel-padded array of ``p``
+  equal-length shard rows (`shard_lengths` / `offsets` are the metadata
+  recovering the exact global layout);
+- ``to_host()`` is the ONLY device->host transfer, lazy and cached;
+- ``consume(fn)`` chains a jitted next stage with buffer donation — the
+  output may alias the sorted buffer (no extra HBM copy) and nothing
+  crosses the relay;
+- ``validate_on_device()`` runs the ``dsort validate`` semantics (order
+  check + FNV-1a multiset checksum, `models.validate`) as jitted shard_map
+  reductions: scalars come back, not O(N) keys.
+
+Fault semantics: `SpmdScheduler` registers every handle it issues and
+invalidates them when the mesh re-forms over survivors (a reaped device may
+own shards of the handle's buffer).  An invalidated handle transparently
+re-runs the sort on the current mesh at next use (counter
+``device_handle_reruns``) — the reference analogue is re-doing a dead
+worker's chunk, applied to a result instead of a task.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dsort_tpu.utils.logging import get_logger
+
+log = get_logger("device_result")
+
+
+class DeviceSortResult:
+    """Handle to a sorted global array left resident on the device mesh.
+
+    Layout contract: ``data`` is reshapeable to ``(p, cap)`` rows, row ``i``
+    holding the ``i``-th global key interval sorted ascending with dtype
+    sentinels padding positions ``>= shard_lengths[i]``.  Rows concatenate
+    (trimmed to their lengths) to the globally sorted output.
+
+    ``mesh``/``axis`` are set when ``data`` is 1-axis-sharded over a worker
+    mesh (the `SampleSort` path — validation then runs as a shard_map
+    program); without them validation runs as a plain jitted reduction
+    (single-device fused results, per-job batch slices).
+    """
+
+    def __init__(
+        self,
+        data,
+        shard_lengths: np.ndarray,
+        n: int,
+        mesh=None,
+        axis: str | None = None,
+        counts_dev=None,
+        metrics=None,
+        label: str = "sort",
+    ):
+        self._data = data
+        self._counts_dev = counts_dev  # device copy, if the producer has one
+        # Captured up front: invalidation drops `_data`, but dtype must
+        # keep answering correctly (empty to_host, repr during drills).
+        self._dtype = np.dtype(data.dtype)
+        self.shard_lengths = np.asarray(shard_lengths, dtype=np.int64)
+        self.n = int(n)
+        self.mesh = mesh
+        self.axis = axis
+        self.label = label
+        self._metrics = metrics
+        self._host: np.ndarray | None = None
+        self._consumed = False
+        self._invalidated = False
+        self._invalid_reason: str | None = None
+        #: Optional zero-arg callable returning a FRESH handle for the same
+        #: job — wired by `SpmdScheduler` so a mesh re-form invalidating
+        #: this handle re-runs transparently instead of erroring.
+        self._rerun = None
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shard_lengths)
+
+    @property
+    def offsets(self) -> np.ndarray:
+        """Global start offset of each shard's valid run (+ total tail)."""
+        return np.concatenate(
+            [[0], np.cumsum(self.shard_lengths)]
+        ).astype(np.int64)
+
+    @property
+    def valid(self) -> bool:
+        return not (self._invalidated or self._consumed)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __repr__(self) -> str:  # state is load-bearing when debugging drills
+        state = (
+            "consumed" if self._consumed
+            else f"invalidated({self._invalid_reason})" if self._invalidated
+            else "live"
+        )
+        return (
+            f"DeviceSortResult(n={self.n}, shards={self.num_shards}, "
+            f"dtype={self.dtype}, {state})"
+        )
+
+    # -- fault wiring ------------------------------------------------------
+
+    def invalidate(self, reason: str) -> None:
+        """Mark the device buffers unusable (the owning mesh re-formed)."""
+        if not self._invalidated:
+            self._invalidated = True
+            self._invalid_reason = reason
+            # The device buffers may live on a reaped device; drop our
+            # references so nothing ever reads them.
+            self._data = None
+            self._counts_dev = None
+
+    def _ensure_live(self) -> None:
+        """Re-run an invalidated handle via its hook; refuse a consumed one."""
+        if self._consumed:
+            raise RuntimeError(
+                "device-resident result was already consumed (its buffer "
+                "was donated to a next stage); re-run the sort"
+            )
+        if not self._invalidated:
+            return
+        if self._rerun is None:
+            raise RuntimeError(
+                f"device-resident result invalidated "
+                f"({self._invalid_reason}) and no re-run hook is attached"
+            )
+        log.warning(
+            "device-resident handle invalidated (%s); re-running on the "
+            "current mesh", self._invalid_reason,
+        )
+        if self._metrics is not None:
+            self._metrics.bump("device_handle_reruns")
+        fresh = self._rerun()
+        # Adopt the fresh handle's device state; keep our re-run hook so a
+        # SECOND re-form re-runs again.
+        self._data = fresh._data
+        self._counts_dev = fresh._counts_dev
+        self._dtype = fresh._dtype
+        self.shard_lengths = fresh.shard_lengths
+        self.mesh, self.axis = fresh.mesh, fresh.axis
+        self._host = fresh._host
+        self._invalidated = False
+        self._invalid_reason = None
+
+    # -- the three verbs ---------------------------------------------------
+
+    def to_host(self) -> np.ndarray:
+        """Assemble the sorted host array — the handle's ONLY D2H, cached.
+
+        Per-shard fetches overlap (``copy_to_host_async``) exactly like the
+        eager drivers' assemble; the result is one contiguous buffer in
+        global order.
+        """
+        if self._host is not None:
+            return self._host
+        if self.n == 0:
+            self._host = np.empty(0, dtype=self.dtype)
+            return self._host
+        self._ensure_live()
+        from dsort_tpu.parallel.sample_sort import _shard_rows
+
+        p = self.num_shards
+        out = np.empty(self.n, dtype=self.dtype)
+        row = _shard_rows(self._data, p)
+        off = 0
+        for i in range(p):
+            ci = int(self.shard_lengths[i])
+            out[off : off + ci] = np.asarray(row(i)).reshape(-1)[:ci]
+            off += ci
+        if off != self.n:  # a torn buffer must never be returned silently
+            raise RuntimeError(
+                f"device shard lengths sum to {off}, expected {self.n} keys"
+            )
+        self._host = out
+        return out
+
+    def consume(self, fn, donate: bool = True):
+        """Chain a jitted next stage over the device-resident buffer.
+
+        ``fn(data)`` receives the sentinel-padded sorted array exactly as it
+        sits on the mesh (use `shard_lengths`/`offsets` for validity —
+        positions ``>= shard_lengths[i]`` inside row ``i`` are pads).  With
+        ``donate=True`` (default) the buffer is donated to the stage — XLA
+        may alias the output over it, so no extra HBM copy exists and the
+        handle is CONSUMED afterwards (later ``to_host``/``validate`` calls
+        refuse).  No host round-trip happens either way.
+
+        Donation is skipped on CPU (XLA CPU ignores it with a per-executable
+        warning, same rule as the sort program's own input donation), but
+        the consumed contract still applies: the caller declared the buffer
+        dead.
+        """
+        self._ensure_live()
+        import jax
+
+        platform = next(iter(self._data.devices())).platform
+        dn = (0,) if donate and platform != "cpu" else ()
+        out = jax.jit(fn, donate_argnums=dn)(self._data)
+        if self._metrics is not None:
+            self._metrics.bump("device_consumes")
+            self._metrics.event(
+                "device_consume", n_keys=self.n, donated=bool(donate)
+            )
+        if donate:
+            self._consumed = True
+            self._data = None
+            self._counts_dev = None
+        return out
+
+    def validate_on_device(self):
+        """`dsort validate` without the relay: order + multiset checksum.
+
+        Runs as jitted (shard_map, when the handle is mesh-sharded)
+        reductions on the device-resident buffer; only three scalars cross
+        to the host.  Returns a `models.validate.ValidationReport` whose
+        ``checksum`` matches the host `_multiset` of the same records — so
+        comparing against the (host-resident) input's checksum proves the
+        permutation without ever fetching the sorted keys.
+        """
+        self._ensure_live()
+        from dsort_tpu.models.validate import validate_device_result
+
+        rep = validate_device_result(self)
+        if self._metrics is not None:
+            self._metrics.bump("device_validates")
+            self._metrics.event(
+                "device_validate", ok=bool(rep.sorted_ok), n=rep.records
+            )
+        return rep

@@ -202,7 +202,23 @@ def _global_fingerprint(local_data, payload=None) -> tuple[str, int]:
     else:
         h = _multiset(local, n, kw)
         dt = str(local.dtype)
-    g = _allgather_u64([h, n])
+    # The dtype/payload-shape tag rides the SAME allgather as (h, n), as a
+    # hash: hosts disagreeing on dtypes or payload trailing shapes would
+    # otherwise compute divergent fingerprints, split the manifest `valid`
+    # decision per process, and deadlock at the next barrier (one clearing
+    # while another resumes).  A tag mismatch is a caller bug — fail loudly
+    # before any divergent control flow instead (ADVICE r5).
+    import zlib
+
+    tag_h = zlib.crc32(dt.encode("utf-8"))
+    g = _allgather_u64([h, n, tag_h])
+    if not (g[:, 2] == g[0, 2]).all():
+        bad = [int(p) for p in np.nonzero(g[:, 2] != g[0, 2])[0]]
+        raise ValueError(
+            f"multihost dtype/payload-shape tag disagrees across processes "
+            f"(this process: {dt!r}; differing process ids: {bad}) — all "
+            "hosts must pass identical key/payload dtypes and shapes"
+        )
     total = int(g[:, 1].sum())
     checksum = int(g[:, 0].sum(dtype=np.uint64))
     return f"{total}:{dt}:{checksum:016x}", total
@@ -499,7 +515,13 @@ def _sort_local_shards_ckpt(local_data, job, axis_name, metrics, job_id):
         and man.get("dtype") == str(local_data.dtype)
     )
     if _mh_stale_clear(ckpt, valid, pid, job_id):
+        # The allgathered clear fired (some process saw stale/orphaned
+        # state): EVERY process must fall through to the fresh sort, even
+        # one that computed valid=True from a raced directory listing —
+        # entering the restore branch here would crash on the cleared
+        # manifest and diverge peers at the next barrier (ADVICE r5).
         man = None
+        valid = False
     if valid:
         done = ckpt.completed_ranges()
         n_ranges = int(man["n_ranges"])
@@ -811,7 +833,11 @@ def _sort_local_records_ckpt(
         and man.get("dtype") == str(keys.dtype)
     )
     if _mh_stale_clear(ckpt, valid, pid, job_id):
+        # Same uniform-fallthrough rule as `_sort_local_shards_ckpt`: a
+        # raced valid=True process must not dereference the cleared
+        # manifest (ADVICE r5).
         man = None
+        valid = False
     if valid:
         n_ranges = int(man["n_ranges"])
         done = ckpt.completed_ranges()

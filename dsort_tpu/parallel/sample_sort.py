@@ -473,14 +473,35 @@ class SampleSort:
     def _cap_pair(self, n_local: int, factor: float) -> int:
         return cap_pair_policy(n_local, factor, self.num_workers)
 
-    def sort(self, data: np.ndarray, metrics: Metrics | None = None) -> np.ndarray:
+    def sort(
+        self,
+        data: np.ndarray,
+        metrics: Metrics | None = None,
+        keep_on_device: bool = False,
+    ) -> np.ndarray:
         """Sort a host array; returns the globally sorted host array.
 
         Float keys (incl. NaN/±0.0/±inf) ride the pipeline as order-preserving
         uints (`ops.float_order`): NaNs sort last like ``np.sort`` and come
         back canonicalized, never trimmed as pads.
+
+        ``keep_on_device=True`` returns a `DeviceSortResult` instead: the
+        sorted global array stays sharded on the mesh (no D2H at all —
+        the completion fetch carries only the retry scalars), with lazy
+        ``.to_host()``, donation-chaining ``.consume(fn)``, and
+        ``.validate_on_device()``.  Integer/uint keys only: a float job's
+        device-resident representation would be the mapped ordered uints,
+        which a next jitted stage must not mistake for values.
         """
         data = np.asarray(data)
+        if keep_on_device:
+            if is_float_key_dtype(data.dtype):
+                raise TypeError(
+                    "keep_on_device supports integer keys only (float keys "
+                    "ride as mapped ordered uints the consumer would "
+                    "misread); use sort() for floats"
+                )
+            return self._sort_device_impl(data, metrics)
         if is_float_key_dtype(data.dtype):
             return sort_float_keys_via_uint(self.sort, data, metrics)
         if len(data) == 0:
@@ -534,6 +555,20 @@ class SampleSort:
             return data.copy(), [data.copy()]
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
+        merged, _, c = self._dispatch_keys(data, timer, metrics)
+        with timer.phase("assemble"):
+            return self._assemble_ranges(merged, c, len(data), self.num_workers)
+
+    def _dispatch_keys(self, data: np.ndarray, timer, metrics: Metrics):
+        """Upload + run the SPMD program with measured-capacity retries.
+
+        The shared dispatch core of the host-returning (`sort_ranges`) and
+        device-resident (`keep_on_device`) paths: returns ``(merged,
+        out_counts, c)`` — the sharded device output, its device per-shard
+        counts, and the host copy of those counts the retry loop already
+        fetched (the ONE small device->host fetch that is both the
+        completion barrier and every retry scalar).
+        """
         p = self.num_workers
         shard_spec = NamedSharding(self.mesh, P(self.axis))
         with timer.phase("partition"):
@@ -560,7 +595,7 @@ class SampleSort:
                 # calls were costing 2 extra trips per sort.
                 c, ov, ml = jax.device_get((out_counts, overflow, max_len))
             if not bool(ov.any()):
-                break
+                return merged, out_counts, c
             metrics.bump("capacity_retries")
             # Size the retry from the measured max bucket (one retry
             # converges: splitters are deterministic for the same data).
@@ -573,10 +608,44 @@ class SampleSort:
                 "bucket overflow (attempt %d, max bucket %d): retrying with "
                 "cap_pair=%d", attempt + 1, observed, cap_pair,
             )
+        raise RuntimeError("sample sort bucket overflow after max retries")
+
+    def _sort_device_impl(self, data: np.ndarray, metrics: Metrics | None):
+        """`keep_on_device` core: dispatch, then hand out the sharded result.
+
+        No assemble phase exists — the sorted global array stays where the
+        SPMD program left it (range-partitioned over the mesh), wrapped in a
+        `DeviceSortResult` carrying the per-shard lengths/offsets and the
+        device copy of the counts (so on-device validation costs zero H2D).
+        """
+        from dsort_tpu.parallel.device_result import DeviceSortResult
+
+        metrics = metrics if metrics is not None else Metrics()
+        timer = PhaseTimer(metrics)
+        if len(data) == 0:
+            import jax.numpy as jnp
+
+            handle = DeviceSortResult(
+                jnp.zeros((0,), dtype=data.dtype),
+                shard_lengths=np.zeros(1, np.int64),
+                n=0, metrics=metrics,
+            )
         else:
-            raise RuntimeError("sample sort bucket overflow after max retries")
-        with timer.phase("assemble"):
-            return self._assemble_ranges(merged, c, len(data), p)
+            merged, out_counts, c = self._dispatch_keys(data, timer, metrics)
+            handle = DeviceSortResult(
+                merged,
+                shard_lengths=c,
+                n=len(data),
+                mesh=self.mesh,
+                axis=self.axis,
+                counts_dev=out_counts,
+                metrics=metrics,
+            )
+        metrics.bump("device_handles")
+        metrics.event(
+            "device_handle", n_keys=handle.n, shards=handle.num_shards
+        )
+        return handle
 
     def _assemble_ranges(
         self, merged, c, n: int, p: int
@@ -782,7 +851,10 @@ class BatchSampleSort:
             # other every run — resume would silently never work.
             raise ValueError(f"duplicate job_ids in batch: {dupes}")
 
-    def sort(self, jobs, metrics: Metrics | None = None, job_ids=None):
+    def sort(
+        self, jobs, metrics: Metrics | None = None, job_ids=None,
+        keep_on_device: bool = False,
+    ):
         """Sort a list of host key arrays; returns the sorted list.
 
         Jobs are grouped into **size buckets** (per-shard capacity rounded up
@@ -799,6 +871,12 @@ class BatchSampleSort:
         without re-sorting (counter ``batch_jobs_restored``), and the
         buckets re-pack over only the missing jobs.  The fingerprint guard
         clears a job's stale result if its data changed.
+
+        ``keep_on_device=True`` returns a list of `DeviceSortResult` handles
+        instead of host arrays: each job's sorted keys stay on device as its
+        slice of the bucket program's output (lazy ``.to_host()``, jitted
+        ``.validate_on_device()``).  Integer keys only, and checkpointing is
+        skipped (a device-resident handle is not a persisted artifact).
         """
         metrics = metrics if metrics is not None else Metrics()
         jobs = [np.asarray(j) for j in jobs]
@@ -811,7 +889,22 @@ class BatchSampleSort:
                 f"all jobs must share one key dtype, got "
                 f"{sorted({str(j.dtype) for j in jobs})}"
             )
-        if is_float_key_dtype(jobs[0].dtype):
+        if keep_on_device:
+            if is_float_key_dtype(jobs[0].dtype):
+                raise TypeError(
+                    "keep_on_device supports integer keys only; use sort() "
+                    "for floats"
+                )
+            if self.job.checkpoint_dir and job_ids:
+                log.warning(
+                    "keep_on_device skips checkpointing: device-resident "
+                    "handles are not persisted artifacts"
+                )
+            # With no ids, `_job_ckpt` stays None everywhere below — the
+            # device-resident batch rides the SAME bucket loop as the
+            # eager path, just with `keep=True` and no persistence.
+            job_ids = None
+        elif is_float_key_dtype(jobs[0].dtype):
             from dsort_tpu.ops.float_order import sort_float_key_batch_via_uint
 
             # Float keys pre-map to ordered uints; checkpoint under the
@@ -836,7 +929,8 @@ class BatchSampleSort:
         for cap in sorted(buckets):
             idxs = buckets[cap]
             for i, out in zip(idxs, self._run_bucket(
-                [jobs[i] for i in idxs], None, cap, metrics
+                [jobs[i] for i in idxs], None, cap, metrics,
+                keep=keep_on_device,
             )):
                 outs[i] = out
                 if ckpts[i] is not None:
@@ -930,13 +1024,18 @@ class BatchSampleSort:
                     ckpts[i].save(1, out[1])
         return outs
 
-    def _run_bucket(self, keys_list, payloads_list, cap: int, metrics: Metrics):
+    def _run_bucket(
+        self, keys_list, payloads_list, cap: int, metrics: Metrics,
+        keep: bool = False,
+    ):
         """Sort ONE uniform-capacity batch (every job fits ``(w, cap)``).
 
         The single bucket driver for both the key-only and kv paths
         (``payloads_list=None`` selects key-only): one copy of the padding
         layout, the measured-capacity retry loop, and the per-worker
-        assemble.  Returns sorted key arrays, or (keys, payload) tuples.
+        assemble.  Returns sorted key arrays, or (keys, payload) tuples —
+        or, with ``keep=True`` (key-only), per-job `DeviceSortResult`
+        handles over the batch output's device-resident job slices.
         """
         kv = payloads_list is not None
         timer = PhaseTimer(metrics)
@@ -995,6 +1094,28 @@ class BatchSampleSort:
                         "cap_pair=%d", observed, cap_pair)
         else:
             raise RuntimeError("sample sort bucket overflow after max retries")
+        if keep:
+            # Device-resident: each job's handle wraps its slice of the
+            # batch output (still on device — slicing the batch dim never
+            # round-trips the keys).  Rows are the p workers' merged runs.
+            from dsort_tpu.parallel.device_result import DeviceSortResult
+
+            cb = c.reshape(batch, p)
+            handles = []
+            for b in range(n_jobs):
+                h = DeviceSortResult(
+                    out_k[b],
+                    shard_lengths=cb[b],
+                    n=int(cb[b].sum()),
+                    metrics=metrics,
+                    label="batch",
+                )
+                metrics.bump("device_handles")
+                metrics.event(
+                    "device_handle", n_keys=h.n, shards=h.num_shards
+                )
+                handles.append(h)
+            return handles
         with timer.phase("assemble"):
             # ONE fetch for everything the assemble needs (keys + payloads
             # ride a single device_get — the file's one-fetch doctrine),

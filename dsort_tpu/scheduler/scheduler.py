@@ -487,6 +487,11 @@ class SpmdScheduler:
         # thread can't be killed anyway.
         self._mesh_lanes: dict = {}
         self._mesh_lanes_lock = threading.Lock()
+        # Outstanding device-resident handles (weakrefs): a mesh re-form
+        # reaps devices that may own shards of a handle's buffer, so every
+        # re-form invalidates them; an invalidated handle re-runs on the
+        # current mesh at next use via the hook `sort` wires up.
+        self._device_handles: list = []
 
     def _mesh_lane(self, key: tuple) -> _AttemptLane:
         with self._mesh_lanes_lock:
@@ -516,6 +521,35 @@ class SpmdScheduler:
 
     def _live_devices(self) -> list[jax.Device]:
         return [self.devices[i] for i in self.table.live_workers()]
+
+    def _register_handle(self, handle) -> None:
+        import weakref
+
+        self._device_handles.append(weakref.ref(handle))
+
+    def _invalidate_handles(self, reason: str, metrics: Metrics) -> None:
+        """Invalidate every outstanding device-resident handle.
+
+        Called wherever the mesh re-forms: the re-formed program set no
+        longer includes the reaped device, and a handle's sharded buffer
+        may live (partly) on it — reading it back would hang or tear.  The
+        handles re-run transparently at next use (`DeviceSortResult`).
+        """
+        live = []
+        for ref in self._device_handles:
+            h = ref()
+            if h is not None and h.valid:
+                h.invalidate(reason)
+                live.append(h)
+        self._device_handles = [r for r in self._device_handles if r() is not None]
+        if live:
+            metrics.event(
+                "device_handle_invalidated", reason=reason, n=len(live)
+            )
+            log.warning(
+                "%d device-resident handle(s) invalidated (%s); they will "
+                "re-run on the re-formed mesh at next use", len(live), reason,
+            )
 
     def _probe_device(self, idx: int) -> bool:
         """Tiny bounded round-trip on one device — SPMD's liveness probe.
@@ -834,12 +868,26 @@ class SpmdScheduler:
         data: np.ndarray,
         metrics: Metrics | None = None,
         job_id: str | None = None,
+        keep_on_device: bool = False,
     ) -> np.ndarray:
+        """Whole-mesh sort; with ``keep_on_device=True`` the result stays
+        sharded on the mesh as a `parallel.DeviceSortResult` under the SAME
+        fault discipline: the attempt runs bounded on the mesh lane, a lost
+        device re-forms the mesh and re-runs, and every handle this
+        scheduler has issued is invalidated by a re-form (its buffer may
+        live on the reaped device) and transparently re-runs on the current
+        mesh at next use.  Device-resident jobs skip range checkpointing —
+        a handle is not a persisted artifact; recovery is the re-run."""
         from jax.sharding import Mesh
 
         from dsort_tpu.parallel.sample_sort import SampleSort
 
         data = np.asarray(data)
+        if keep_on_device and is_float_key_dtype(data.dtype):
+            raise TypeError(
+                "keep_on_device supports integer keys only; use sort() "
+                "for floats"
+            )
         if is_float_key_dtype(data.dtype):
             # Map floats before the checkpointed local-sort phase too — a
             # checkpointed run of raw floats would already have dropped NaNs.
@@ -851,6 +899,13 @@ class SpmdScheduler:
         self.table.revive_all()
         ckpt = None
         work = data
+        if keep_on_device and self.job.checkpoint_dir and job_id:
+            log.warning(
+                "keep_on_device skips range checkpointing for job %r: the "
+                "device-resident handle re-runs on failure instead of "
+                "restoring persisted ranges", job_id,
+            )
+            job_id = None
         if self.job.checkpoint_dir and job_id and len(data):
             from dsort_tpu.checkpoint import ShardCheckpoint
             from dsort_tpu.models.external_sort import _fingerprint
@@ -922,6 +977,8 @@ class SpmdScheduler:
                 if ss is None:
                     mesh = Mesh(np.array(devs), (self.axis,))
                     ss = self._sorters[key] = SampleSort(mesh, self.job, self.axis)
+                if keep_on_device:
+                    return ss.sort(work, metrics, keep_on_device=True)
                 if ckpt is None:
                     return ss.sort(work, metrics)
                 return self._shuffle_with_range_checkpoint(
@@ -937,6 +994,15 @@ class SpmdScheduler:
                 )
                 for i in live:  # proof of life: the collective completed
                     self.table.heartbeat(i)
+                if keep_on_device:
+                    # Fault wiring: a later mesh re-form invalidates this
+                    # handle (its shards may sit on the reaped device);
+                    # the hook re-runs the job on whatever mesh is then
+                    # live, so the handle heals instead of erroring.
+                    out._rerun = lambda: self.sort(
+                        data, metrics=metrics, keep_on_device=True
+                    )
+                    self._register_handle(out)
                 metrics.event(
                     "job_done", n_keys=len(data),
                     counters=dict(metrics.counters),
@@ -951,6 +1017,7 @@ class SpmdScheduler:
                 metrics.event("worker_dead", worker=e.worker, stage=e.stage)
                 metrics.bump("mesh_reforms")
                 metrics.event("mesh_reform", survivors=len(live) - 1)
+                self._invalidate_handles("mesh_reform", metrics)
                 time.sleep(self.job.settle_delay_s)
             except ProgramWaitTimeout as e:
                 # The in-flight program wait lapsed — the hang the reference
@@ -973,6 +1040,7 @@ class SpmdScheduler:
                     metrics.event(
                         "mesh_reform", survivors=len(live) - len(dead)
                     )
+                    self._invalidate_handles("mesh_reform", metrics)
                 elif transient_retries < self.job.max_transient_retries:
                     transient_retries += 1
                     wait_lapses += 1
@@ -1009,6 +1077,7 @@ class SpmdScheduler:
                     metrics.event(
                         "mesh_reform", survivors=len(live) - len(dead)
                     )
+                    self._invalidate_handles("mesh_reform", metrics)
                 elif transient_retries < self.job.max_transient_retries:
                     transient_retries += 1
                     metrics.bump("transient_retries")

@@ -54,6 +54,14 @@ EVENT_TYPES: dict[str, str] = {
     "worker_join": "a worker joined the native coordinator cluster (worker)",
     "task_done": "one shard's result landed (native coordinator; worker, "
                  "task)",
+    "device_handle": "a device-resident result handle was issued "
+                     "(n_keys, shards)",
+    "device_handle_invalidated": "a mesh re-form invalidated outstanding "
+                                 "device-resident handles (reason, n)",
+    "device_validate": "on-device validation ran over a device-resident "
+                       "result (ok, n)",
+    "device_consume": "a jitted next stage consumed a device-resident "
+                      "result (n_keys, donated)",
 }
 
 #: THE counter registry: every `Metrics.bump` name in the package, with its
@@ -85,6 +93,11 @@ COUNTERS: dict[str, str] = {
     "runs_resumed": "external-sort runs restored from a previous run",
     "runs_sorted": "external-sort runs sorted this run",
     "native_merges": "k-way merges executed in native code",
+    "device_handles": "device-resident result handles issued",
+    "device_handle_reruns": "invalidated device-resident handles re-run on "
+                            "the current mesh",
+    "device_validates": "on-device validations executed",
+    "device_consumes": "device-resident results consumed by a jitted stage",
 }
 
 

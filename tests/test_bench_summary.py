@@ -106,3 +106,49 @@ def test_emit_summary_prints_compact_last(capsys):
     assert full["metric"] == "summary"
     assert compact["metric"] == "compact_summary"
     assert len(out[1]) < 1800
+
+
+def test_full_summary_keeps_metric_collisions(capsys):
+    """ADVICE r5 low: two emitted lines sharing a metric label (e.g. ladder
+    variants distinguished only by `kernel`) must BOTH survive into the full
+    summary's `lines` — keyed apart, never silently overwritten."""
+    bench._EMITTED.clear()
+    try:
+        bench._EMITTED.append(
+            {"metric": "config9_variant", "value": 1.0, "unit": "keys/sec",
+             "kernel": "block"}
+        )
+        bench._EMITTED.append(
+            {"metric": "config9_variant", "value": 2.0, "unit": "keys/sec",
+             "kernel": "lax"}
+        )
+        bench._EMITTED.append(  # no kernel extra at all: index-suffixed
+            {"metric": "config9_variant", "value": 3.0, "unit": "keys/sec"}
+        )
+        bench._emit_summary()
+    finally:
+        bench._EMITTED.clear()
+    out = capsys.readouterr().out.strip().splitlines()
+    full, compact = json.loads(out[0]), json.loads(out[1])
+    assert len(full["lines"]) == 3
+    values = sorted(e["value"] for e in full["lines"].values())
+    assert values == [1.0, 2.0, 3.0]
+    assert "config9_variant" in full["lines"]
+    assert "config9_variant#lax" in full["lines"]
+    # the compact line keeps all three too (suffix dedupe)
+    assert len(compact["l"]) == 3
+
+
+def test_compact_summary_size_holds_under_collisions():
+    """The size bound holds even when the suite contains duplicate metric
+    labels (the collision case the full summary now disambiguates)."""
+    emitted = _fake_emitted(16)
+    for i, kern in enumerate(("block", "lax", "bitonic", "radix")):
+        ln = dict(emitted[0])
+        ln["kernel"] = kern
+        ln["value"] = float(i)
+        emitted.append(ln)
+    compact = bench._compact_summary(emitted)
+    encoded = json.dumps(compact)
+    assert len(encoded) < 1800, f"{len(encoded)} bytes"
+    assert len(compact["l"]) == 20  # nothing dropped

@@ -402,3 +402,96 @@ def test_multihost_kv_partial_checkpoint_resorts(tmp_path):
     metas3 = [json.load(open(r3 / f"meta_{i}.json")) for i in range(2)]
     for meta in metas3:
         assert meta["counters"].get("multihost_ranges_restored") == 2
+
+
+# ---- single-process regressions (ADVICE r5) -------------------------------
+#
+# These force internal branches directly (no subprocess cluster needed: the
+# multihost drivers run single-process against the simulated mesh, with the
+# cross-host decisions monkeypatched to the raced outcome).
+
+
+def test_mh_stale_clear_resets_valid_keys_path(tmp_path, monkeypatch):
+    """ADVICE r5 medium: `_mh_stale_clear` returning True on a process that
+    computed valid=True (the raced directory listing its allgather exists to
+    cover) must fall through to the fresh sort — before the fix it crashed
+    on `int(None["n_ranges"])` and diverged peers at the next barrier."""
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.parallel import distributed as dist
+    from dsort_tpu.utils.metrics import Metrics
+
+    rng = np.random.default_rng(51)
+    data = rng.integers(0, 10**6, 20_000).astype(np.int32)
+    job = JobConfig(checkpoint_dir=str(tmp_path))
+    out, off = dist.sort_local_shards(
+        data, job=job, metrics=Metrics(), job_id="stale"
+    )
+    np.testing.assert_array_equal(out, np.sort(data))
+    # Second run WOULD full-restore (manifest + range valid) — force the
+    # raced-clear vote instead: some peer saw stale state and everyone
+    # agreed to clear.
+    monkeypatch.setattr(dist, "_mh_stale_clear", lambda *a, **k: True)
+    m = Metrics()
+    out2, off2 = dist.sort_local_shards(
+        data, job=job, metrics=m, job_id="stale"
+    )
+    np.testing.assert_array_equal(out2, np.sort(data))
+    assert off2 == 0
+    # the restore path never ran: the job re-sorted fresh
+    assert "multihost_ranges_restored" not in m.counters
+
+
+def test_mh_stale_clear_resets_valid_kv_path(tmp_path, monkeypatch):
+    """The same raced-clear regression on `_sort_local_records_ckpt`
+    (ADVICE r5 names both call sites)."""
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.ingest import gen_terasort, terasort_secondary
+    from dsort_tpu.parallel import distributed as dist
+    from dsort_tpu.utils.metrics import Metrics
+
+    keys, payload = gen_terasort(2000, seed=53)
+    sec = terasort_secondary(payload)
+    order = np.lexsort((sec, keys))
+    job = JobConfig(checkpoint_dir=str(tmp_path), key_dtype=np.uint64)
+    out_k, out_v, _ = dist.sort_local_records(
+        keys, payload, secondary=sec, job=job, metrics=Metrics(),
+        job_id="stale_kv",
+    )
+    np.testing.assert_array_equal(out_k, keys[order])
+    monkeypatch.setattr(dist, "_mh_stale_clear", lambda *a, **k: True)
+    m = Metrics()
+    out_k2, out_v2, off2 = dist.sort_local_records(
+        keys, payload, secondary=sec, job=job, metrics=m, job_id="stale_kv"
+    )
+    np.testing.assert_array_equal(out_k2, keys[order])
+    np.testing.assert_array_equal(out_v2, payload[order])
+    assert off2 == 0
+    assert "multihost_ranges_restored" not in m.counters
+
+
+def test_global_fingerprint_tag_mismatch_raises(monkeypatch):
+    """ADVICE r5 low: hosts passing different dtypes/payload shapes must
+    fail loudly at the fingerprint allgather, not deadlock at a later
+    barrier with divergent `valid` decisions."""
+    from dsort_tpu.parallel import distributed as dist
+
+    real = dist._allgather_u64
+
+    def two_hosts_one_differs(vals):
+        g = real(vals)
+        if g.shape[1] == 3:  # the (h, n, tag_hash) fingerprint gather
+            g = np.vstack([g, g])
+            g[1, 2] ^= np.uint64(1)  # host 1 computed a different tag
+        return g
+
+    monkeypatch.setattr(dist, "_allgather_u64", two_hosts_one_differs)
+    data = np.arange(100, dtype=np.int32)
+    with pytest.raises(ValueError, match="tag disagrees"):
+        dist._global_fingerprint(data)
+    # agreeing hosts still fingerprint fine (identical rows)
+    monkeypatch.setattr(
+        dist, "_allgather_u64",
+        lambda vals: np.vstack([real(vals), real(vals)]),
+    )
+    fp, total = dist._global_fingerprint(data)
+    assert total == 200  # two simulated hosts' counts sum

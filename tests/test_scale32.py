@@ -1,0 +1,152 @@
+"""North-star-scale CPU evidence (VERDICT r5 weak #6 / next #6).
+
+The in-process suite simulates 8 devices (conftest pins the XLA device
+count at backend init), so P=16/P=32 behavior — splitter quality, the
+32->31 mesh re-form, capacity quantization at wide meshes — ran nowhere.
+These tests spawn subprocesses with their OWN simulated device counts and
+drive the public APIs at those widths; the capacity-policy quantization
+checks are pure host math and run in-process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dsort_tpu.parallel.sample_sort import (
+    cap_from_observed,
+    cap_pair_policy,
+    next_cap_pair,
+)
+
+
+def _run_ndev(n_devices: int, body: str, timeout: float = 540.0) -> str:
+    """Run ``body`` in a fresh interpreter simulating ``n_devices`` CPUs."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # REPLACE the parent's flag (conftest pinned 8): the child must
+    # initialize its backend at the requested width.
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_devices}"
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", body], env=env, capture_output=True,
+        text=True, timeout=timeout,
+    )
+    assert r.returncode == 0, (
+        f"{n_devices}-device subprocess failed:\n{r.stdout}\n{r.stderr}"
+    )
+    return r.stdout
+
+
+_BODY_16 = r"""
+import json
+import jax, numpy as np
+jax.config.update("jax_enable_x64", True)
+from dsort_tpu.config import JobConfig
+from dsort_tpu.data.ingest import gen_zipf
+from dsort_tpu.models.validate import _multiset
+from dsort_tpu.parallel.mesh import local_device_mesh
+from dsort_tpu.parallel.sample_sort import SampleSort
+from dsort_tpu.utils.metrics import Metrics
+
+assert len(jax.devices()) == 16, jax.devices()
+mesh = local_device_mesh(16)
+# Splitter quality at P=16 on Zipf skew: correct output, bounded retries.
+data = gen_zipf(1 << 17, a=1.2, seed=41)
+m = Metrics()
+ss = SampleSort(mesh, JobConfig(key_dtype=np.int64))
+out = ss.sort(data, metrics=m)
+np.testing.assert_array_equal(out, np.sort(data))
+# Device-resident handle + on-device validation at P=16.
+h = ss.sort(data, keep_on_device=True)
+rep = h.validate_on_device()
+assert rep.sorted_ok and rep.records == len(data)
+assert rep.checksum == _multiset(data, len(data), data.dtype.itemsize)
+assert h.num_shards == 16
+print(json.dumps({
+    "ok": True,
+    "capacity_retries": m.counters.get("capacity_retries", 0),
+}))
+"""
+
+
+_BODY_32 = r"""
+import json
+import jax, numpy as np
+jax.config.update("jax_enable_x64", True)
+from dsort_tpu.config import JobConfig, MeshConfig
+from dsort_tpu.data.ingest import gen_uniform, gen_zipf
+from dsort_tpu.parallel.mesh import make_mesh
+from dsort_tpu.parallel.sample_sort import SampleSort
+from dsort_tpu.scheduler import FaultInjector, SpmdScheduler
+from dsort_tpu.utils.metrics import Metrics
+
+assert len(jax.devices()) == 32, jax.devices()
+mesh = make_mesh(MeshConfig(num_workers=32), jax.devices())
+# 1) P=32 splitter quality: uniform AND Zipf at 2^18, exact vs np.sort.
+#    32 splitters from 32*oversample samples must hold buckets near the
+#    ideal N/32 — assert no more than one measured-capacity retry fired.
+for seed, gen in ((43, gen_uniform), (44, lambda n, seed: gen_zipf(n, a=1.2, seed=seed))):
+    data = gen(1 << 18, seed=seed)
+    m = Metrics()
+    job = JobConfig() if data.dtype.itemsize == 4 else JobConfig(key_dtype=data.dtype)
+    out = SampleSort(mesh, job).sort(data, metrics=m)
+    np.testing.assert_array_equal(out, np.sort(data))
+    assert m.counters.get("capacity_retries", 0) <= 1, m.counters
+# 2) The 32->31 mesh re-form: lose device 17 mid-shuffle, re-form over 31
+#    survivors (a non-power-of-two mesh), still exact.
+inj = FaultInjector()
+sched = SpmdScheduler(job=JobConfig(settle_delay_s=0.01), injector=inj)
+data = gen_uniform(1 << 18, seed=45)
+inj.fail_once(17, "spmd")
+m = Metrics()
+out = sched.sort(data, metrics=m)
+np.testing.assert_array_equal(out, np.sort(data))
+assert m.counters.get("mesh_reforms") == 1
+assert not sched.table.is_alive(17)
+assert len(sched.table.live_workers()) == 31
+print(json.dumps({"ok": True, "mesh_reforms": m.counters["mesh_reforms"]}))
+"""
+
+
+def test_scale_16_devices_dryrun():
+    """P=16: Zipf splitter quality + device-resident validation, subprocess
+    with a 16-device simulated mesh."""
+    out = json.loads(_run_ndev(16, _BODY_16).strip().splitlines()[-1])
+    assert out["ok"] is True
+    # Zipf at capacity_factor 1.3 with measured retries: at most one resize.
+    assert out["capacity_retries"] <= 1
+
+
+@pytest.mark.slow  # two 32-wide meshes compile (32 and the re-formed 31)
+def test_scale_32_devices_splitters_and_reform():
+    """P=32 splitter quality and the 32->31 injected-loss mesh re-form."""
+    out = json.loads(_run_ndev(32, _BODY_32).strip().splitlines()[-1])
+    assert out["ok"] is True and out["mesh_reforms"] == 1
+
+
+def test_capacity_policy_quantization_at_scale():
+    """The capacity policy at P=16/32 (host math — no devices needed):
+    quantization keeps distinct compiled programs bounded while the cap
+    never exceeds the shard size and never drops below alignment."""
+    for p in (16, 32):
+        n_local = 1 << 18
+        cap = cap_pair_policy(n_local, 1.3, p)
+        assert cap % 8 == 0 and 8 <= cap <= n_local
+        # measured resize quantizes to 1/8 of the ideal bucket: <= ~9
+        # distinct steps between the ideal and the n_local clamp
+        step = max(n_local // (8 * p), 8)
+        caps = {
+            cap_from_observed(obs, n_local, p)
+            for obs in range(n_local // p, n_local + 1, step)
+        }
+        assert all(c % step == 0 or c == n_local for c in caps)
+        assert len(caps) <= 8 * p  # bounded compile count
+        # growth invariant: a retry is always strictly larger
+        c0 = cap_pair_policy(n_local, 1.0, p)
+        assert next_cap_pair(c0 + 1, c0, n_local, p) > c0
