@@ -16,6 +16,11 @@ bench-smoke:  ## device-resident sort + on-device validate on the 8-device cpu m
 	$(PY) -m dsort_tpu.cli bench --device-resident --n 200000 --reps 2 \
 	--journal /tmp/dsort_bench_smoke.jsonl
 
+bench-exchange-smoke:  ## ring-vs-alltoall exchange A/B (uniform + zipf) on the 8-device cpu mesh
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m dsort_tpu.cli bench --exchange-ab --n 200000 --reps 2 \
+	--journal /tmp/dsort_bench_exchange_smoke.jsonl
+
 native:  ## build libdsort_native.so
 	$(MAKE) -C $(NATIVE)
 
@@ -33,4 +38,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke native tsan asan ubsan sanitize

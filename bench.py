@@ -49,6 +49,128 @@ import numpy as np
 
 REFERENCE_KEYS_PER_SEC = 16_384 / 0.374  # BASELINE.md measured, ~4.38e4
 
+# -- artifact schema (VERDICT r5 missing #1 successor: self-parsing) --------
+#
+# Every artifact this driver emits opens with ONE header line carrying the
+# schema version and the line contract; `bench.py --check ARTIFACT`
+# round-trips every line against the header it finds (or against the v0
+# default below for pre-header artifacts), so a reader — or CI — can verify
+# an artifact without knowing which bench revision wrote it.
+
+BENCH_SCHEMA_VERSION = 1
+#: Keys every metric line must carry, with their JSON types.
+BENCH_SCHEMA_REQUIRED = {"metric": "str", "value": "num", "unit": "str"}
+#: Known optional fields: PRESENT fields must match these types; fields not
+#: listed here are free-form extras (allowed — lines carry workload context).
+BENCH_SCHEMA_FIELD_TYPES = {
+    "vs_baseline": "num",
+    "chained_value": "num",
+    "method": "str",
+    "kernel": "str",
+    "fixed_overhead_ms_per_dispatch": "num",
+    "validated_ok": "bool",
+    "bit_identical": "bool",
+    "host_fraction": "num",
+    "host_fraction_link": "num",
+    "host_fraction_code": "num",
+    "expected_transfer_s": "num",
+    "phases_seconds": "obj",
+    "ms_per_merge": "obj",
+    "lines": "obj",
+    "l": "obj",
+    "bytes_on_wire": "num",
+    "bytes_on_wire_alltoall": "num",
+    "bytes_saved": "num",
+    "speedup_vs_alltoall": "num",
+    "speedup_vs_relay_e2e": "num",
+    "capacity_retries": "num",
+    "capacity_retries_alltoall": "num",
+    "capacity_retries_ring": "num",
+    "mesh_reforms": "num",
+    "exchange": "str",
+    "error": "str",
+    "skipped": "str",
+}
+
+_SCHEMA_TYPE_CHECKS = {
+    "str": lambda v: isinstance(v, str),
+    "num": lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+    "bool": lambda v: isinstance(v, bool),
+    "obj": lambda v: isinstance(v, dict),
+}
+
+
+def _schema_header() -> dict:
+    return {
+        "bench_schema": BENCH_SCHEMA_VERSION,
+        "required": BENCH_SCHEMA_REQUIRED,
+        "field_types": BENCH_SCHEMA_FIELD_TYPES,
+    }
+
+
+def check_artifact(path: str) -> list[str]:
+    """Validate one artifact; returns a list of violations (empty = OK).
+
+    Each line must be a JSON object that survives a dumps/loads round trip;
+    metric lines must carry the required keys at the required types, and
+    any field the schema knows must match its declared type.  A header line
+    (``bench_schema``) switches validation to the contract it embeds —
+    artifacts written before the header default to the v0 contract (same
+    required keys, this file's known-field table).
+    """
+    errors: list[str] = []
+    required = dict(BENCH_SCHEMA_REQUIRED)
+    field_types = dict(BENCH_SCHEMA_FIELD_TYPES)
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw_lines = f.readlines()
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    saw_metric = False
+    for lineno, raw in enumerate(raw_lines, 1):
+        line = raw.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"{path}:{lineno}: not JSON: {e}")
+            continue
+        if not isinstance(obj, dict):
+            errors.append(f"{path}:{lineno}: line is not a JSON object")
+            continue
+        if json.loads(json.dumps(obj)) != obj:
+            errors.append(f"{path}:{lineno}: does not round-trip")  # pragma: no cover
+            continue
+        if "bench_schema" in obj:
+            if saw_metric:
+                errors.append(
+                    f"{path}:{lineno}: schema header after metric lines"
+                )
+            if not isinstance(obj["bench_schema"], int):
+                errors.append(f"{path}:{lineno}: bench_schema not an int")
+            if isinstance(obj.get("required"), dict):
+                required = obj["required"]
+            if isinstance(obj.get("field_types"), dict):
+                field_types = obj["field_types"]
+            continue
+        saw_metric = True
+        for key, typ in required.items():
+            if key not in obj:
+                errors.append(f"{path}:{lineno}: missing required {key!r}")
+            elif not _SCHEMA_TYPE_CHECKS.get(typ, lambda v: True)(obj[key]):
+                errors.append(
+                    f"{path}:{lineno}: {key!r} is not of type {typ!r}"
+                )
+        for key, typ in field_types.items():
+            if key in obj and not _SCHEMA_TYPE_CHECKS.get(
+                typ, lambda v: True
+            )(obj[key]):
+                errors.append(
+                    f"{path}:{lineno}: {key!r} is not of type {typ!r}"
+                )
+    return errors
+
 
 def _ensure_responsive_backend() -> None:
     """Guard against a wedged accelerator runtime.
@@ -391,6 +513,9 @@ def _probe_transfer(reps: int, nbytes: int = 32 << 20) -> dict | None:
 
 def main() -> None:
     _ensure_responsive_backend()
+    # The schema header is the artifact's FIRST line — printed directly
+    # (not via _emit_line) so the summary never mistakes it for a metric.
+    print(json.dumps(_schema_header()), flush=True)
     try:
         _main_body()
     finally:
@@ -676,6 +801,12 @@ def _main_body() -> None:
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
+    # The cpu-mesh subprocesses import dsort_tpu (one via `-m`): pin the
+    # repo root on PYTHONPATH so they work from any cwd.
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__))]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
     cpu_script = r"""
 import json, time
 import jax
@@ -718,6 +849,54 @@ print(json.dumps({
         _emit(
             "config5_zipf_1M_injected_failure_8dev_cpu_mesh",
             0.0, "keys/sec", baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
+
+    # Ring-vs-alltoall exchange ladder (ISSUE 4): the adaptive ppermute
+    # schedule against the one-shot padded collective, on the 8-device cpu
+    # mesh (the schedules are the same program on a single chip — the mesh
+    # is where an exchange exists to compare).  The harness is `dsort
+    # bench --exchange-ab` — ONE copy of the A/B contract, shared with
+    # `make bench-exchange-smoke` — re-emitted here with the cpu-mesh
+    # suffix; rows: uniform int32 1M, zipf int64 1M (the capacity-retry
+    # workload), TeraSort kv records, each carrying per-sort
+    # `bytes_on_wire` for both schedules (every attempt charged: an
+    # overflowed padded dispatch pays for the shipment it then re-did).
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "dsort_tpu.cli", "bench",
+                "--exchange-ab", "--n", str(1 << 20), "--reps", "3",
+            ],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        # Parse rows BEFORE judging the exit code: a bit-identical failure
+        # exits 1 but its rows carry the diagnosis (which workload, and
+        # bit_identical=false) — dropping them for a generic error line
+        # would hide exactly what the A/B exists to catch.  Per-line
+        # parsing, so one torn line (killed subprocess mid-print) cannot
+        # take the complete rows down with it.
+        rows = []
+        for ln in r.stdout.strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        for row in rows:
+            row["metric"] += "_8dev_cpu_mesh"
+            _emit_line(row)
+        if not rows:
+            raise RuntimeError(
+                f"exchange A/B emitted no rows (rc {r.returncode}): "
+                + (r.stderr.strip().splitlines() or ["no stderr"])[-1][:160]
+            )
+    except Exception as e:  # the ladder must never sink the artifact
+        _emit(
+            "exchange_ring_vs_alltoall_8dev_cpu_mesh", 0.0, "keys/sec",
+            baseline=False,
             error=(str(e).splitlines() or [repr(e)])[0][:200],
         )
 
@@ -868,35 +1047,45 @@ from dsort_tpu.parallel.sample_sort import SampleSort
 from dsort_tpu.utils.metrics import Metrics
 ss = SampleSort(local_device_mesh(), JobConfig(local_kernel="lax"))
 u = gen_uniform(1 << 20, seed=9)
-ss.sort(u)
-best = None
-for _ in range(3):
-    m = Metrics()
-    t0 = time.perf_counter()
-    ss.sort(u, metrics=m)
-    total = time.perf_counter() - t0
-    if best is None or total < best[0]:
-        best = (total, m)
-total, m = best
-host_s = m.phase_s.get("partition", 0.0) + m.phase_s.get("assemble", 0.0)
-print(json.dumps({
-    "value": round((1 << 20) / total, 1),
-    "phases_seconds": {k: round(v, 4) for k, v in sorted(m.phase_s.items())},
-    "host_fraction": round(host_s / total, 3),
-}))
+for exch in ("alltoall", "ring"):
+    ss.sort(u, exchange=exch)
+    best = None
+    for _ in range(3):
+        m = Metrics()
+        t0 = time.perf_counter()
+        ss.sort(u, metrics=m, exchange=exch)
+        total = time.perf_counter() - t0
+        if best is None or total < best[0]:
+            best = (total, m)
+    total, m = best
+    host_s = m.phase_s.get("partition", 0.0) + m.phase_s.get("assemble", 0.0)
+    print(json.dumps({
+        "exchange": exch,
+        "value": round((1 << 20) / total, 1),
+        "phases_seconds": {k: round(v, 4) for k, v in sorted(m.phase_s.items())},
+        "host_fraction": round(host_s / total, 3),
+    }))
 """
     try:
         r = subprocess.run(
             [sys.executable, "-c", cpu_phase_script], env=env,
             capture_output=True, text=True, timeout=600, check=True,
         )
-        info = json.loads(r.stdout.strip().splitlines()[-1])
-        _emit(
-            "spmd_sort_1M_phase_split_8dev_cpu_mesh",
-            info["value"], "keys/sec", baseline=False,
-            phases_seconds=info["phases_seconds"],
-            host_fraction=info["host_fraction"],
-        )
+        # One row per exchange schedule: the ring's phase split lands next
+        # to the all_to_all's so the e2e overlap effect is in-artifact.
+        for ln in r.stdout.strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            info = json.loads(ln)
+            suffix = "_ring" if info.get("exchange") == "ring" else ""
+            _emit(
+                f"spmd_sort_1M_phase_split_8dev_cpu_mesh{suffix}",
+                info["value"], "keys/sec", baseline=False,
+                phases_seconds=info["phases_seconds"],
+                host_fraction=info["host_fraction"],
+                exchange=info.get("exchange", "alltoall"),
+            )
     except Exception as e:
         _emit(
             "spmd_sort_1M_phase_split_8dev_cpu_mesh",
@@ -933,5 +1122,26 @@ print(json.dumps({
         )
 
 
+def _check_main(paths: list[str]) -> int:
+    """``bench.py --check ARTIFACT...``: validate artifacts, report, exit.
+
+    Needs no accelerator backend (and must not touch one: the checker runs
+    in tier-1 CI against the in-tree ``BENCH_*.jsonl`` artifacts).
+    """
+    if not paths:
+        print("usage: bench.py --check ARTIFACT [ARTIFACT...]", file=sys.stderr)
+        return 2
+    bad = 0
+    for p in paths:
+        errs = check_artifact(p)
+        for e in errs:
+            print(e, file=sys.stderr)
+        print(f"{p}: {'OK' if not errs else f'{len(errs)} schema violations'}")
+        bad += bool(errs)
+    return 1 if bad else 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--check":
+        sys.exit(_check_main(sys.argv[2:]))
     sys.exit(main())

@@ -81,6 +81,8 @@ def _load_config(args) -> SortConfig:
         job_over["local_kernel"] = args.kernel
     if getattr(args, "merge_kernel", None):
         job_over["merge_kernel"] = args.merge_kernel
+    if getattr(args, "exchange", None):
+        job_over["exchange"] = args.exchange
     if getattr(args, "checkpoint_dir", None):
         job_over["checkpoint_dir"] = args.checkpoint_dir
     if job_over:
@@ -659,11 +661,157 @@ def _bench_device_resident(args, cfg: SortConfig) -> int:
     return 0 if ok else 1
 
 
+def _bench_exchange_ab(args, cfg: SortConfig) -> int:
+    """`dsort bench --exchange-ab`: ring-vs-alltoall A/B on the local mesh.
+
+    The `make bench-exchange-smoke` target (tier-1-gated like bench-smoke),
+    and THE ring-vs-alltoall harness — bench.py's cpu-mesh ladder shells
+    out to this command so the A/B contract lives in one place: for a
+    uniform int32, a zipf-skewed int64, and a TeraSort kv workload, sorts
+    the same data through both exchange schedules, asserts the outputs
+    bit-identical, and emits one JSON line per workload with both
+    throughputs and the measured per-sort ``bytes_on_wire`` of each
+    schedule (from the ``exchange_bytes_on_wire`` counter, which charges
+    every attempt — an overflowed padded dispatch pays for its failed
+    shipment too).
+    """
+    import jax
+
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.ingest import gen_terasort, gen_uniform, gen_zipf
+    from dsort_tpu.parallel.mesh import local_device_mesh
+    from dsort_tpu.parallel.sample_sort import SampleSort
+
+    mesh = local_device_mesh(cfg.mesh.num_workers)
+    # Guard on the mesh ACTUALLY used (a NUM_WORKERS=1 config on an
+    # 8-device host would otherwise silently benchmark alltoall against
+    # itself — resolve_exchange forces ring back to alltoall at P=1).
+    if mesh.shape["w"] < 2:
+        raise SystemExit(
+            "--exchange-ab needs a multi-worker mesh (the ring and the "
+            "all_to_all are the same program on one worker); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 without "
+            "NUM_WORKERS=1"
+        )
+    journal = _open_journal(args)
+    tk, tv = gen_terasort(1 << 16, seed=3)
+    cases = [
+        (
+            f"uniform_int32_{args.n}",
+            gen_uniform(args.n, seed=0),
+            None,
+            JobConfig(local_kernel=cfg.job.local_kernel),
+        ),
+        (
+            f"zipf_int64_{args.n}",
+            gen_zipf(args.n, a=1.3, seed=4),
+            None,
+            JobConfig(key_dtype=np.int64, local_kernel=cfg.job.local_kernel),
+        ),
+        (
+            "kv_65536_records",
+            tk,
+            tv,
+            JobConfig(
+                key_dtype=np.uint64, payload_bytes=tv.shape[1],
+                local_kernel=cfg.job.local_kernel,
+            ),
+        ),
+    ]
+    ok_all = True
+    try:
+        for label, keys, payload, job in cases:
+            ss = SampleSort(mesh, job)
+
+            def run(exch, m=None):
+                if payload is None:
+                    return ss.sort(keys, metrics=m, exchange=exch)
+                return ss.sort_kv(keys, payload, metrics=m, exchange=exch)
+
+            def canonical(out):
+                # Keys-only: the sorted array compares directly.  kv: keys
+                # must be bit-identical AND the records the same multiset —
+                # payload order among EQUAL keys is unspecified on both
+                # schedules (unstable local sorts), so compare records in a
+                # canonical (key, payload-bytes) order; this is what
+                # catches a ring payload-permutation bug that ships sorted
+                # keys over scrambled values.
+                if payload is None:
+                    return out
+                k, v = out
+                order = np.lexsort(
+                    tuple(v[:, i] for i in range(v.shape[1])) + (k,)
+                )
+                return k, k[order].tobytes() + v[order].tobytes()
+
+            results, stats = {}, {}
+            for exch in ("alltoall", "ring"):
+                run(exch)  # warm/compile
+                times = []
+                m = Metrics(journal=journal)
+                for _ in range(args.reps):
+                    t0 = time.perf_counter()
+                    out = run(exch, m)
+                    times.append(time.perf_counter() - t0)
+                results[exch] = canonical(out)
+                # Counters accumulated over the reps: report EVERYTHING
+                # per-sort (each rep restarts from the policy capacity, so
+                # retries divide evenly like the bytes).
+                stats[exch] = {
+                    "dt": float(min(times)),  # one-sided jitter doctrine
+                    "bytes": m.counters.get("exchange_bytes_on_wire", 0)
+                    // args.reps,
+                    "retries": m.counters.get("capacity_retries", 0)
+                    // args.reps,
+                    "saved": m.counters.get("exchange_bytes_saved", 0)
+                    // args.reps,
+                }
+            if payload is None:
+                identical = bool(
+                    np.array_equal(results["alltoall"], results["ring"])
+                )
+            else:
+                identical = bool(
+                    np.array_equal(
+                        results["alltoall"][0], results["ring"][0]
+                    )
+                ) and results["alltoall"][1] == results["ring"][1]
+            ok_all = ok_all and identical
+            n = len(keys)
+            print(json.dumps({
+                "metric": f"exchange_ring_vs_alltoall_{label}",
+                "value": round(n / stats["ring"]["dt"], 1),
+                "unit": "keys/sec" if payload is None else "rec/sec",
+                "alltoall_keys_per_sec": round(
+                    n / stats["alltoall"]["dt"], 1
+                ),
+                "speedup_vs_alltoall": round(
+                    stats["alltoall"]["dt"] / stats["ring"]["dt"], 2
+                ),
+                "bytes_on_wire": stats["ring"]["bytes"],
+                "bytes_on_wire_alltoall": stats["alltoall"]["bytes"],
+                "bytes_saved": stats["ring"]["saved"],
+                "capacity_retries_alltoall": stats["alltoall"]["retries"],
+                "capacity_retries_ring": stats["ring"]["retries"],
+                "bit_identical": identical,
+            }), flush=True)
+    finally:
+        _write_journal(journal, args)
+    return 0 if ok_all else 1
+
+
 def cmd_bench(args) -> int:
     from dsort_tpu.data.ingest import gen_uniform
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if getattr(args, "exchange_ab", False):
+        if args.suite or getattr(args, "device_resident", False):
+            raise SystemExit(
+                "--exchange-ab is its own benchmark: run it as a separate "
+                "invocation"
+            )
+        return _bench_exchange_ab(args, _load_config(args))
     if args.suite and getattr(args, "device_resident", False):
         # The ladder has its own metric contract; silently dropping one of
         # two explicit flags would ship an artifact missing the lines the
@@ -1089,6 +1237,10 @@ def main(argv=None) -> int:
                        choices=["auto", "sort", "bitonic", "block_merge"],
                        help="post-shuffle combine (default auto: block_merge "
                             "wherever the block kernel applies)")
+        p.add_argument("--exchange", choices=["alltoall", "ring"],
+                       help="bucket exchange schedule (default alltoall; "
+                            "ring = chunked ppermute with adaptive per-step "
+                            "headroom and merge-as-you-receive)")
         p.add_argument("--checkpoint-dir",
                        help="persist per-shard/range progress here; a re-run "
                             "of the same input resumes instead of re-sorting")
@@ -1123,6 +1275,10 @@ def main(argv=None) -> int:
     p.add_argument("--device-resident", action="store_true",
                    help="time the no-relay path: device-resident sort + "
                         "on-device validation, one JSON line each")
+    p.add_argument("--exchange-ab", action="store_true",
+                   help="ring-vs-alltoall exchange A/B on the local mesh "
+                        "(uniform + zipf; asserts bit-identical outputs, "
+                        "reports bytes_on_wire per schedule)")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser(

@@ -97,6 +97,11 @@ class JobConfig:
     # applies — measured 6x the flat re-sort on chip) | "sort" | "bitonic"
     # | "block_merge".
     merge_kernel: str = "auto"
+    # Bucket exchange schedule: "alltoall" = one-shot padded collective;
+    # "ring" = P-1 chunked ppermute steps with merge-as-you-receive and
+    # per-step buffer capacities sized from the measured bucket histogram
+    # (`parallel.exchange`) — bit-identical output, adaptive headroom.
+    exchange: str = "alltoall"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
     # Per-(src,dst) all_to_all bucket headroom over the ideal n/P split.
@@ -151,6 +156,10 @@ class JobConfig:
                 "merge_kernel must be 'auto', 'sort', 'bitonic' or "
                 f"'block_merge', got {self.merge_kernel!r}"
             )
+        if self.exchange not in ("alltoall", "ring"):
+            raise ConfigError(
+                f"exchange must be 'alltoall' or 'ring', got {self.exchange!r}"
+            )
         if self.oversample < 1:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")
         if self.capacity_factor < 1.0:
@@ -188,7 +197,7 @@ class SortConfig:
         Accepts the reference's exact keys (``SERVER_IP``, ``SERVER_PORT``)
         plus framework keys (``NUM_WORKERS``, ``KEY_DTYPE``, ``OVERSAMPLE``,
         ``CAPACITY_FACTOR``, ``PAYLOAD_BYTES``, ``HEARTBEAT_TIMEOUT_S``,
-        ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``).
+        ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``, ``EXCHANGE``).
         """
         def geti(key: str, default: int | None) -> int | None:
             return int(m[key]) if key in m else default
@@ -204,6 +213,7 @@ class SortConfig:
             payload_bytes=geti("PAYLOAD_BYTES", 0),
             local_kernel=m.get("LOCAL_KERNEL", JobConfig.local_kernel),
             merge_kernel=m.get("MERGE_KERNEL", JobConfig.merge_kernel),
+            exchange=m.get("EXCHANGE", JobConfig.exchange),
             oversample=geti("OVERSAMPLE", JobConfig.oversample),
             capacity_factor=float(
                 m.get("CAPACITY_FACTOR", JobConfig.capacity_factor)

@@ -1,0 +1,523 @@
+"""Ring-schedule bucket exchange: chunked ppermute + merge-as-you-receive.
+
+The one-shot ``all_to_all`` data plane (`parallel.sample_sort`) pads every
+``(src, dst)`` bucket to ONE worst-case capacity, so exchange bytes scale
+with ``P x max_bucket`` instead of the data actually moving, a skewed input
+overflows the static buffer and re-dispatches the whole job, and the
+per-chip merge cannot start until the last bucket lands.  This module
+decomposes the shuffle into a **ring schedule** — the portable
+point-to-point decomposition of the ragged bucket redistribution
+(arXiv:2112.01075), pipelined against the merge the way Exoshuffle
+(arXiv:2301.03734) overlaps its shuffle with reduce:
+
+- **Plan phase** (`_ring_plan_shard`): local sort + splitter selection +
+  the cheap lengths exchange — an ``all_gather`` of the per-destination
+  bucket histogram.  Only the ``(P, P)`` int32 histogram crosses to the
+  host; the sorted shard stays device-resident for the exchange phase.
+- **Adaptive headroom** (`ring_caps`): each ring step ``k`` moves the
+  buckets at source→destination shift ``k``; its buffer is sized from the
+  *actual* max bucket length over that step's ``(src, dst)`` pairs,
+  quantized to the same 8-element (vreg sublane / DMA tile) grid and
+  skew-step ladder the capacity retry already uses
+  (`sample_sort.cap_from_observed`), so the number of distinct compiled
+  ring programs a skewed workload can demand stays bounded.  Because the
+  plan measured the real histogram, the old capacity-overflow retry — a
+  full re-dispatch — becomes a per-step buffer size chosen *before* the
+  exchange runs; overflow on this path is an invariant violation, not a
+  retry.
+- **Exchange phase** (`_ring_exchange_shard` / `_ring_exchange_kv_shard`):
+  ``P-1`` ``jax.lax.ppermute`` steps (shift ``k`` sends bucket
+  ``(me+k) % P`` and receives from ``(me-k) % P``), double-buffered so the
+  program issues step ``k``'s transfer and THEN folds the run received at
+  step ``k-1`` into an incremental binary-counter merge tower
+  (`_tower_push`) — merge-as-you-receive instead of merge-after-barrier.
+  XLA's scheduler is free to run the collective-permute of step ``k``
+  concurrently with the merge compute of step ``k-1`` (the XLA-level
+  analogue of the Pallas double-buffered ring pattern); total merge work
+  stays the ``O(N/P * log P)`` of the barrier merge, just spread across
+  the steps.  The eager fold runs only where a genuine run-merge entry
+  exists (``block_merge`` — the block kernel's ~log P-level merge entry —
+  or the bitonic merge tree); when the job's combine resolves to the flat
+  re-sort (e.g. the CPU mesh), per-step folds would re-sort the
+  accumulated data once per tower level, so the ring then collects runs
+  and sorts once — the one-shot combine — keeping the adaptive-headroom
+  win without a merge-work regression.
+
+Every run is **bit-identical** to the ``all_to_all`` path: both produce the
+sorted multiset of the destination's key range, and sorted arrays of equal
+multisets are equal.  Drivers select the schedule via
+``JobConfig.exchange`` or the per-call ``exchange=`` override
+(`SampleSort.sort`, `BatchSampleSort.sort`, `SpmdScheduler.sort`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsort_tpu.ops.local_sort import sentinel_for
+
+__all__ = [
+    "ring_caps",
+    "ring_step_quantum",
+    "ring_wire_bytes",
+    "alltoall_wire_bytes",
+    "note_ring_plan",
+    "note_alltoall_attempt",
+    "resolve_exchange",
+    "check_ring_overflow",
+]
+
+
+def resolve_exchange(value: str | None, default: str, num_workers: int) -> str:
+    """THE exchange-schedule resolver, shared by every driver: per-call
+    override > config default; a 1-worker mesh always takes the all_to_all
+    path (the shard program short-circuits after the local sort — there is
+    nothing to exchange)."""
+    exch = value if value is not None else default
+    if exch not in ("alltoall", "ring"):
+        raise ValueError(
+            f"exchange must be 'alltoall' or 'ring', got {exch!r}"
+        )
+    return "alltoall" if num_workers == 1 else exch
+
+
+def note_alltoall_attempt(
+    metrics, cap_pair: int, bytes_per_slot: int, num_workers: int,
+    jobs: int = 1,
+) -> None:
+    """Charge one padded all_to_all dispatch's wire bytes — EVERY attempt,
+    including one that overflows and re-dispatches (its bytes crossed the
+    wire too).  The single accounting rule behind the alltoall side of the
+    ``exchange_bytes_on_wire`` counter, shared by all three drivers."""
+    if num_workers > 1:
+        metrics.bump(
+            "exchange_bytes_on_wire",
+            jobs * alltoall_wire_bytes(cap_pair, bytes_per_slot, num_workers),
+        )
+
+
+def check_ring_overflow(overflow) -> None:
+    """Raise on a ring-exchange overflow scalar — shared by every ring
+    dispatch.  Unlike the padded path's capacity retry this is an invariant
+    violation: the buffers were sized from the measured histogram, so
+    overflow means the exchange ran against a different splitter plan than
+    the one that sized them."""
+    if bool(np.asarray(overflow).any()):
+        raise RuntimeError(
+            "ring exchange bucket overflow: the exchange ran against a "
+            "different splitter plan than the one that sized its buffers"
+        )
+
+
+# -- adaptive per-step capacity (host side) ---------------------------------
+
+
+def ring_step_quantum(n_local: int, num_workers: int) -> int:
+    """The cap quantization grid: 8-aligned (vreg sublane / DMA tile rule
+    `ops.block_sort` encodes — rows move in (8, 128) tiles, so every buffer
+    length the kernels see is a multiple of 8) and stepped at 1/8 of the
+    ideal bucket so a skewed workload can demand at most ~9 distinct
+    compiled ring programs between the ideal split and the ``n_local``
+    clamp — the same ladder as `sample_sort.cap_from_observed`."""
+    return max(-(-max(n_local // (8 * num_workers), 8) // 8) * 8, 8)
+
+
+def _quantize_cap(max_len: int, n_local: int, num_workers: int) -> int:
+    step = ring_step_quantum(n_local, num_workers)
+    cap = -(-int(max_len) // step) * step if max_len > 0 else step
+    cap = min(-(-cap // 8) * 8, max(-(-n_local // 8) * 8, 8))
+    return max(cap, 8)
+
+
+def step_maxes(hist: np.ndarray, num_workers: int) -> list[int]:
+    """Per-step measured max bucket length: step ``k`` of the ring moves
+    every ``(src, (src+k) % P)`` bucket at once, so its buffer requirement
+    is the max over that diagonal.  ``hist`` may carry a leading batch
+    dimension (the batched driver): maxes are then over jobs as well."""
+    p = num_workers
+    hist = np.asarray(hist).reshape(-1, p, p)
+    return [
+        int(max(hist[:, src, (src + k) % p].max() for src in range(p)))
+        for k in range(p)
+    ]
+
+
+def ring_caps(hist: np.ndarray, n_local: int, num_workers: int) -> tuple[int, ...]:
+    """Per-step buffer capacities from the measured bucket histogram.
+
+    ``hist[src, dst]`` is the length of source ``src``'s bucket for
+    destination ``dst`` (the plan phase's all_gathered lengths).  Each
+    step's capacity is its measured diagonal max (`step_maxes`), quantized
+    (`_quantize_cap`).  Step 0 is the device's own bucket (no transfer),
+    sized the same way so the merged output shape is static.
+    """
+    return tuple(
+        _quantize_cap(m, n_local, num_workers)
+        for m in step_maxes(hist, num_workers)
+    )
+
+
+def ring_wire_bytes(caps, bytes_per_slot: int, num_workers: int) -> int:
+    """Bytes the ring schedule puts on the wire (whole mesh): every device
+    sends one ``caps[k]`` buffer per transfer step; step 0 stays local."""
+    return int(sum(caps[1:]) * bytes_per_slot * num_workers)
+
+
+def alltoall_wire_bytes(cap_pair: int, bytes_per_slot: int, num_workers: int) -> int:
+    """Bytes the padded ``all_to_all`` puts on the wire (whole mesh): every
+    device sends ``P-1`` off-device rows of the static ``(P, cap_pair)``
+    buffer (the own-row ``P``-th slice never leaves the chip)."""
+    return int((num_workers - 1) * cap_pair * bytes_per_slot * num_workers)
+
+
+def note_ring_plan(
+    metrics, caps, hist, n_local: int, num_workers: int, bytes_per_slot: int,
+    capacity_factor: float, jobs: int = 1,
+) -> None:
+    """Journal one planned ring schedule: per-step events + wire counters.
+
+    ``exchange_step`` records each transfer step's capacity and wire bytes;
+    ``exchange_resize`` fires for every step whose MEASURED max bucket
+    (pre-quantization, so rounding up to the cap grid never fakes one)
+    exceeds what the static policy (`cap_pair_policy` at the job's
+    ``capacity_factor``) would have allocated — i.e. exactly the steps
+    where the padded path would have overflowed and re-dispatched the whole
+    job; here the resize happened per step, before the exchange ran.
+    ``exchange_bytes_saved`` credits the ring against what the padded path
+    would actually have shipped for THIS histogram: the policy-sized
+    buffer, plus — when any measured bucket exceeds the policy capacity —
+    the whole resized re-dispatch the overflow retry would have added.
+    """
+    from dsort_tpu.parallel.sample_sort import cap_pair_policy, next_cap_pair
+
+    p = num_workers
+    maxes = step_maxes(hist, p)
+    policy_cap = cap_pair_policy(n_local, capacity_factor, p)
+    ring_b = ring_wire_bytes(caps, bytes_per_slot, p) * jobs
+    padded_b = alltoall_wire_bytes(policy_cap, bytes_per_slot, p) * jobs
+    max_pair = max(maxes)
+    if max_pair > policy_cap:
+        retry_cap = next_cap_pair(max_pair, policy_cap, n_local, p)
+        padded_b += alltoall_wire_bytes(retry_cap, bytes_per_slot, p) * jobs
+    metrics.bump("exchange_ring_steps", (p - 1) * jobs)
+    metrics.bump("exchange_bytes_on_wire", ring_b)
+    metrics.bump("exchange_bytes_saved", max(padded_b - ring_b, 0))
+    for k in range(1, p):
+        metrics.event(
+            "exchange_step", step=k, cap=int(caps[k]),
+            bytes=int(caps[k]) * bytes_per_slot * p * jobs,
+        )
+        if maxes[k] > policy_cap:
+            metrics.event(
+                "exchange_resize", step=k, cap=int(caps[k]),
+                observed=maxes[k], policy_cap=policy_cap,
+            )
+
+
+# -- shard-level building blocks (run under shard_map) ----------------------
+
+
+def _bucket_bounds(xs_sorted, count, splitters):
+    """(starts, lens) of the per-destination contiguous slices — the ring
+    counterpart of `sample_sort._bucket_slices`, without materializing the
+    padded ``(P, cap)`` gather index (each step gathers its own slice)."""
+    bounds = jnp.clip(
+        jnp.searchsorted(xs_sorted, splitters, side="left").astype(jnp.int32),
+        0,
+        count,
+    )
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), bounds])
+    ends = jnp.concatenate([bounds, count[None].astype(jnp.int32)])
+    return starts, jnp.maximum(ends - starts, 0)
+
+
+def _bucket_gather(xs_sorted, starts, lens, row, cap: int):
+    """One destination's slice as a static ``(cap,)`` sentinel-padded run.
+
+    ``row`` is a traced destination index (the ring step decides it per
+    device), ``cap`` is static; positions beyond the bucket's true length
+    are masked to the dtype sentinel so received runs are sorted runs."""
+    n_local = xs_sorted.shape[0]
+    sent = sentinel_for(xs_sorted.dtype)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    idx = jnp.clip(starts[row] + pos, 0, max(n_local - 1, 0))
+    return jnp.where(pos < lens[row], xs_sorted[idx], sent), idx, pos
+
+
+def _pad_run(run, length: int, fill):
+    if run.shape[0] == length:
+        return run
+    return jnp.concatenate(
+        [run, jnp.full((length - run.shape[0],), fill, run.dtype)]
+    )
+
+
+def _merge2(a, b, merge_kernel: str, kernel: str):
+    """Merge two sorted sentinel-padded runs into one sorted run.
+
+    Runs are padded to a shared 8-aligned length and combined through the
+    SAME kernel dispatch as the barrier merge (`_merge_received`): the
+    block-bitonic merge entry where the block kernel applies, the flat
+    re-sort elsewhere — so the tower's per-step folds and the one-shot
+    path produce identical orderings."""
+    from dsort_tpu.parallel.sample_sort import _merge_received
+
+    length = -(-max(a.shape[0], b.shape[0]) // 8) * 8
+    sent = sentinel_for(a.dtype)
+    return _merge_received(
+        jnp.stack([_pad_run(a, length, sent), _pad_run(b, length, sent)]),
+        merge_kernel,
+        kernel,
+    )
+
+
+def _merge2_kv(a, b, total: int, merge_kernel: str, kernel: str):
+    """kv tower merge: runs are ``(keys, tag)`` pairs ordered by
+    ``(key, tag)`` — the tag (flat receive position, ``+ total`` for pads)
+    keeps real keys equal to the sentinel ahead of padding, exactly the
+    `_merge_received_kv` tiebreak, and doubles as the payload gather
+    permutation after the final fold."""
+    ka, ta = a
+    kb, tb = b
+    from dsort_tpu.parallel.sample_sort import _resolve_merge_kernel
+
+    length = -(-max(ka.shape[0], kb.shape[0]) // 8) * 8
+    sent = sentinel_for(ka.dtype)
+    pad_tag = jnp.int32(2 * total)
+    resolved = _resolve_merge_kernel(merge_kernel, kernel, ka.dtype, 2 * length)
+    if resolved == "block_merge":
+        from dsort_tpu.ops.bitonic import _ceil_pow2
+        from dsort_tpu.ops.block_sort import LANES, block_merge_runs_kv
+
+        # Pre-pad to a shape block_merge_runs_kv never pads internally
+        # (pow2 columns, 2 rows x length >= 8*LANES): its internal pad
+        # ranks scale with the LOCAL merge size (2*n) and would sort
+        # BEFORE this tower's GLOBAL tags at equal (sentinel) keys,
+        # dropping real sentinel-keyed records at the trim.  Our own pads
+        # carry ``2*total`` — above every real tag by construction.
+        length = max(_ceil_pow2(length), 4 * LANES)
+        ka, ta = _pad_run(ka, length, sent), _pad_run(ta, length, pad_tag)
+        kb, tb = _pad_run(kb, length, sent), _pad_run(tb, length, pad_tag)
+        return block_merge_runs_kv(
+            jnp.stack([ka, kb]), jnp.stack([ta, tb])
+        )
+    ka, ta = _pad_run(ka, length, sent), _pad_run(ta, length, pad_tag)
+    kb, tb = _pad_run(kb, length, sent), _pad_run(tb, length, pad_tag)
+    out_k, out_t = jax.lax.sort(
+        (jnp.concatenate([ka, kb]), jnp.concatenate([ta, tb])),
+        dimension=-1,
+        num_keys=2,
+        is_stable=False,
+    )
+    return out_k, out_t
+
+
+def _tower_push(tower: list, run, merge2) -> None:
+    """Binary-counter merge tower: fold the newly received run, merging
+    equal-rank runs so total merge work stays O(N log P) while each fold
+    runs between a step's ppermute issue and the next step's — the
+    merge-as-you-receive schedule."""
+    tower.append((run, 1))
+    while len(tower) >= 2 and tower[-1][1] == tower[-2][1]:
+        b, rb = tower.pop()
+        a, ra = tower.pop()
+        tower.append((merge2(a, b), ra + rb))
+
+
+def _tower_fold(tower: list, merge2):
+    """Collapse the remaining (distinct-rank) runs, smallest first, into the
+    final sorted run."""
+    acc, _ = tower.pop()
+    while tower:
+        a, _ = tower.pop()
+        acc = merge2(a, acc)
+    return acc
+
+
+def _ring_perm(num_workers: int, k: int):
+    return [(i, (i + k) % num_workers) for i in range(num_workers)]
+
+
+# -- the shard programs -----------------------------------------------------
+
+
+def _ring_plan_shard(xs, count, *, num_workers, oversample, axis, kernel="lax"):
+    """Plan phase: local sort + splitters + the cheap lengths exchange.
+
+    Returns ``(xs_sorted, splitters, hist)`` — the sorted shard stays
+    sharded (device-resident input of the exchange phase), the splitters
+    and the ``(P, P)`` bucket histogram are replicated; the host fetches
+    only the histogram to size the per-step ring buffers."""
+    from dsort_tpu.parallel.sample_sort import _choose_splitters
+    from dsort_tpu.ops.local_sort import sort_padded
+
+    count = count[0]
+    xs, _ = sort_padded(xs, count, kernel)
+    splitters = _choose_splitters(xs, count, num_workers, oversample, axis)
+    _, lens = _bucket_bounds(xs, count, splitters)
+    hist = jax.lax.all_gather(lens, axis)  # (P, P) replicated
+    return xs, splitters, hist
+
+
+def _ring_plan_kv_shard(
+    keys, payload, count, *, num_workers, oversample, axis, kernel="lax"
+):
+    """kv plan phase: the payload rides the local sort so the exchange
+    phase's bucket gathers see key-aligned rows."""
+    from dsort_tpu.parallel.sample_sort import _choose_splitters
+    from dsort_tpu.ops.local_sort import sort_kv_padded
+
+    count = count[0]
+    keys, payload, _ = sort_kv_padded(keys, payload, count, stable=False)
+    splitters = _choose_splitters(keys, count, num_workers, oversample, axis)
+    _, lens = _bucket_bounds(keys, count, splitters)
+    hist = jax.lax.all_gather(lens, axis)
+    return keys, payload, splitters, hist
+
+
+def _ring_exchange_shard(
+    xs, count, splitters, *, num_workers, caps, axis,
+    merge_kernel="auto", kernel="lax",
+):
+    """Exchange phase, keys only: P-1 ppermute steps + tower merge.
+
+    ``caps`` (static tuple) are the plan-measured per-step capacities.
+    Returns ``(merged, out_count (1,), overflow (1,))``; ``merged`` is the
+    device's sorted key range padded to ``sum(caps)``.  ``overflow`` can
+    only fire if the exchange ran against a different splitter plan than
+    the one that sized ``caps`` — an invariant violation the host raises
+    on, never a retry."""
+    from dsort_tpu.parallel.sample_sort import _resolve_merge_kernel
+
+    p = num_workers
+    count = count[0]
+    me = jax.lax.axis_index(axis)
+    starts, lens = _bucket_bounds(xs, count, splitters)
+    total = int(sum(caps))
+    # Merge-as-you-receive only pays where a genuine run-merge entry exists
+    # (the block kernel's ~log P-level merge entry; the bitonic merge tree):
+    # when the job's combine resolves to the flat re-sort, an eager fold
+    # would re-sort the accumulated data once per tower level — log P times
+    # the one-shot path's merge work — so the ring then collects runs and
+    # sorts once at the end, exactly the all_to_all combine, and the ring's
+    # win is the adaptive headroom alone.
+    eager = _resolve_merge_kernel(merge_kernel, kernel, xs.dtype, total) != "sort"
+
+    def merge2(a, b):
+        return _merge2(a, b, merge_kernel, kernel)
+
+    def fold(tower, run):
+        if eager:
+            _tower_push(tower, run, merge2)
+        else:
+            tower.append(run)
+
+    own, _, _ = _bucket_gather(xs, starts, lens, me, caps[0])
+    overflow = lens[me] > caps[0]
+    out_count = lens[me].astype(jnp.int32)
+    tower: list = []
+    prev = own
+    for k in range(1, p):
+        row = (me + jnp.int32(k)) % p
+        blk, _, _ = _bucket_gather(xs, starts, lens, row, caps[k])
+        overflow = overflow | (lens[row] > caps[k])
+        perm = _ring_perm(p, k)
+        recv = jax.lax.ppermute(blk, axis, perm)
+        recv_len = jax.lax.ppermute(lens[row][None], axis, perm)[0]
+        out_count = out_count + recv_len
+        # Fold the PREVIOUS step's run while this step's transfer is in
+        # flight — the double buffer: `prev` is the recv buffer being
+        # consumed, `recv` the one being filled.
+        fold(tower, prev)
+        prev = recv
+    fold(tower, prev)
+    if eager:
+        merged = _tower_fold(tower, merge2)[:total]
+    else:
+        from dsort_tpu.ops.local_sort import sort_with_kernel
+
+        merged = sort_with_kernel(jnp.concatenate(tower), kernel)[:total]
+    return merged, out_count[None], overflow[None]
+
+
+def _ring_exchange_kv_shard(
+    keys, payload, count, splitters, *, num_workers, caps, axis,
+    merge_kernel="auto", kernel="lax",
+):
+    """Exchange phase, key+payload: keys ride the merge tower as
+    ``(key, tag)`` pairs; payload rows ride only the ppermute and land in a
+    flat step-ordered buffer, gathered ONCE by the final permutation the
+    tags encode — merge-as-you-receive on the expensive key plane without
+    per-step payload shuffles."""
+    from dsort_tpu.ops.local_sort import _apply_perm
+    from dsort_tpu.parallel.sample_sort import _resolve_merge_kernel
+
+    p = num_workers
+    count = count[0]
+    me = jax.lax.axis_index(axis)
+    starts, lens = _bucket_bounds(keys, count, splitters)
+    total = int(sum(caps))
+    offsets = np.concatenate([[0], np.cumsum(caps)]).astype(np.int32)
+    # Same eager-vs-deferred rule as the keys path, but the kv tower's only
+    # genuine run-merge entry is the block kernel's (`_merge2_kv` has no
+    # bitonic kv entry — "bitonic" would fall back to a flat lax.sort per
+    # fold, the exact per-level re-sort the deferral exists to avoid).
+    eager = (
+        _resolve_merge_kernel(merge_kernel, kernel, keys.dtype, total)
+        == "block_merge"
+    )
+
+    def merge2(a, b):
+        return _merge2_kv(a, b, total, merge_kernel, kernel)
+
+    def fold(tower, run):
+        if eager:
+            _tower_push(tower, run, merge2)
+        else:
+            tower.append(run)
+
+    def tagged(run_k, run_len, step: int):
+        pos = jnp.arange(caps[step], dtype=jnp.int32)
+        is_pad = pos >= run_len
+        return run_k, jnp.int32(offsets[step]) + pos + is_pad * total
+
+    # Pad positions' payload rows are never gathered (their tags map to
+    # gather index 0 and sit beyond the valid count) — no masking needed.
+    own_k, own_idx, _ = _bucket_gather(keys, starts, lens, me, caps[0])
+    vals = [payload[own_idx]]
+    overflow = lens[me] > caps[0]
+    out_count = lens[me].astype(jnp.int32)
+    tower: list = []
+    prev = tagged(own_k, lens[me], 0)
+    for k in range(1, p):
+        row = (me + jnp.int32(k)) % p
+        blk, idx, _ = _bucket_gather(keys, starts, lens, row, caps[k])
+        overflow = overflow | (lens[row] > caps[k])
+        perm = _ring_perm(p, k)
+        recv_k = jax.lax.ppermute(blk, axis, perm)
+        recv_v = jax.lax.ppermute(payload[idx], axis, perm)
+        recv_len = jax.lax.ppermute(lens[row][None], axis, perm)[0]
+        out_count = out_count + recv_len
+        fold(tower, prev)  # overlap: fold step k-1's run
+        prev = tagged(recv_k, recv_len, k)
+        vals.append(recv_v)
+    fold(tower, prev)
+    if eager:
+        merged_k, merged_t = _tower_fold(tower, merge2)
+    else:
+        merged_k, merged_t = jax.lax.sort(
+            (
+                jnp.concatenate([r[0] for r in tower]),
+                jnp.concatenate([r[1] for r in tower]),
+            ),
+            dimension=-1,
+            num_keys=2,
+            is_stable=False,
+        )
+    merged_k, merged_t = merged_k[:total], merged_t[:total]
+    flat_v = jnp.concatenate(vals, axis=0)  # (total, ...) step-ordered
+    gather = jnp.where(merged_t < total, merged_t, 0)
+    out_v = _apply_perm(flat_v, gather, 0)
+    return merged_k, out_v, out_count[None], overflow[None]

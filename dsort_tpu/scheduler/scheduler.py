@@ -663,6 +663,7 @@ class SpmdScheduler:
     def _shuffle_with_range_checkpoint(
         self, work: np.ndarray, ckpt, ss, metrics: Metrics, live: list[int],
         cancelled: threading.Event | None = None,
+        exchange: str | None = None,
     ) -> np.ndarray:
         """Phase B with per-range persistence (SURVEY.md §5.4, upgraded).
 
@@ -687,9 +688,12 @@ class SpmdScheduler:
                     [ckpt.load_range(i) for i in sorted(done)]
                 )
             return self._resume_missing_ranges(
-                work, ckpt, ss, done, metrics, cancelled
+                work, ckpt, ss, done, metrics, cancelled, exchange
             )
-        outs = ss.sort_ranges(work, metrics)
+        # None -> no kwarg: monkeypatched sort_ranges wrappers keep working.
+        outs = ss.sort_ranges(
+            work, metrics, **({} if exchange is None else {"exchange": exchange})
+        )
         self._check_cancelled(cancelled)
         # Fresh sort: the range views share ONE backing buffer already laid
         # out in global order — return it instead of re-concatenating (the
@@ -729,6 +733,7 @@ class SpmdScheduler:
     def _resume_missing_ranges(
         self, work: np.ndarray, ckpt, ss, done: list[int], metrics: Metrics,
         cancelled: threading.Event | None = None,
+        exchange: str | None = None,
     ) -> np.ndarray:
         """Re-sort only the key intervals whose ranges were lost.
 
@@ -768,7 +773,9 @@ class SpmdScheduler:
             len(done), (ckpt.manifest() or {}).get("n_ranges", -1),
             len(subset), len(work),
         )
-        sorted_subset = ss.sort(subset, metrics)
+        sorted_subset = ss.sort(
+            subset, metrics, **({} if exchange is None else {"exchange": exchange})
+        )
         present_concat = (
             np.concatenate(present) if present else subset[:0]
         )
@@ -869,6 +876,7 @@ class SpmdScheduler:
         metrics: Metrics | None = None,
         job_id: str | None = None,
         keep_on_device: bool = False,
+        exchange: str | None = None,
     ) -> np.ndarray:
         """Whole-mesh sort; with ``keep_on_device=True`` the result stays
         sharded on the mesh as a `parallel.DeviceSortResult` under the SAME
@@ -877,7 +885,15 @@ class SpmdScheduler:
         scheduler has issued is invalidated by a re-form (its buffer may
         live on the reaped device) and transparently re-runs on the current
         mesh at next use.  Device-resident jobs skip range checkpointing —
-        a handle is not a persisted artifact; recovery is the re-run."""
+        a handle is not a persisted artifact; recovery is the re-run.
+
+        ``exchange`` ("alltoall" | "ring", default `JobConfig.exchange`)
+        selects the shuffle schedule with the SAME fault contract: a device
+        lost mid-ring (between the plan and exchange dispatches, or inside
+        either program) invalidates the exchange, the mesh re-forms over
+        the survivors, and the job re-runs there — the re-formed plan
+        re-measures its histogram, so the ring's adaptive buffers re-size
+        to the new mesh automatically."""
         from jax.sharding import Mesh
 
         from dsort_tpu.parallel.sample_sort import SampleSort
@@ -891,7 +907,9 @@ class SpmdScheduler:
         if is_float_key_dtype(data.dtype):
             # Map floats before the checkpointed local-sort phase too — a
             # checkpointed run of raw floats would already have dropped NaNs.
-            return sort_float_keys_via_uint(self.sort, data, metrics, job_id)
+            return sort_float_keys_via_uint(
+                self.sort, data, metrics, job_id, exchange=exchange
+            )
         metrics = metrics if metrics is not None else Metrics()
         metrics.event(
             "job_start", mode="spmd", n_keys=len(data), job_id=job_id
@@ -977,12 +995,33 @@ class SpmdScheduler:
                 if ss is None:
                     mesh = Mesh(np.array(devs), (self.axis,))
                     ss = self._sorters[key] = SampleSort(mesh, self.job, self.axis)
+                # Mid-ring injection point: the hook runs between the ring
+                # plan and exchange dispatches (SampleSort.fault_hook), so
+                # a drill can lose a device with the sorted shards already
+                # device-resident and the schedule planned — the exchange
+                # is invalidated and the job re-runs on the re-formed mesh.
+                if self.injector is not None:
+                    current = list(live)
+
+                    def ring_hook():
+                        for i in current:
+                            self.injector.check(i, "ring")
+
+                    ss.fault_hook = ring_hook
+                else:
+                    ss.fault_hook = None
+                # Pass the override only when the caller set one: `None`
+                # means "JobConfig.exchange decides" and needs no plumbing —
+                # wrappers around SampleSort.sort (fault drills monkeypatch
+                # it) keep their pre-exchange signature working.
+                kw = {} if exchange is None else {"exchange": exchange}
                 if keep_on_device:
-                    return ss.sort(work, metrics, keep_on_device=True)
+                    return ss.sort(work, metrics, keep_on_device=True, **kw)
                 if ckpt is None:
-                    return ss.sort(work, metrics)
+                    return ss.sort(work, metrics, **kw)
                 return self._shuffle_with_range_checkpoint(
-                    work, ckpt, ss, metrics, live, cancelled
+                    work, ckpt, ss, metrics, live, cancelled,
+                    exchange=exchange,
                 )
 
             try:
@@ -1000,7 +1039,8 @@ class SpmdScheduler:
                     # the hook re-runs the job on whatever mesh is then
                     # live, so the handle heals instead of erroring.
                     out._rerun = lambda: self.sort(
-                        data, metrics=metrics, keep_on_device=True
+                        data, metrics=metrics, keep_on_device=True,
+                        exchange=exchange,
                     )
                     self._register_handle(out)
                 metrics.event(

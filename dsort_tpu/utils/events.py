@@ -62,6 +62,11 @@ EVENT_TYPES: dict[str, str] = {
                        "result (ok, n)",
     "device_consume": "a jitted next stage consumed a device-resident "
                       "result (n_keys, donated)",
+    "exchange_step": "one ring exchange step was planned with its measured "
+                     "capacity (step, cap, bytes)",
+    "exchange_resize": "a ring step's adaptive capacity exceeded the static "
+                       "policy allocation — the per-step successor of the "
+                       "whole-job capacity retry (step, cap, policy_cap)",
 }
 
 #: THE counter registry: every `Metrics.bump` name in the package, with its
@@ -98,6 +103,11 @@ COUNTERS: dict[str, str] = {
                             "the current mesh",
     "device_validates": "on-device validations executed",
     "device_consumes": "device-resident results consumed by a jitted stage",
+    "exchange_ring_steps": "ring exchange transfer steps executed",
+    "exchange_bytes_on_wire": "bytes the bucket exchange put on the wire "
+                              "(both schedules; whole mesh)",
+    "exchange_bytes_saved": "wire bytes the ring schedule avoided vs the "
+                            "policy-sized padded all_to_all",
 }
 
 

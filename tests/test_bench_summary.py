@@ -152,3 +152,89 @@ def test_compact_summary_size_holds_under_collisions():
     encoded = json.dumps(compact)
     assert len(encoded) < 1800, f"{len(encoded)} bytes"
     assert len(compact["l"]) == 20  # nothing dropped
+
+
+# ---- artifact self-parsing: schema header + bench.py --check --------------
+
+
+def test_schema_header_shape():
+    hdr = bench._schema_header()
+    assert hdr["bench_schema"] == bench.BENCH_SCHEMA_VERSION
+    assert hdr["required"] == {"metric": "str", "value": "num", "unit": "str"}
+    # The header itself is one JSON line well under any tail bound.
+    assert len(json.dumps(hdr)) < 1800
+
+
+def test_check_artifact_accepts_valid_lines(tmp_path):
+    p = tmp_path / "art.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(bench._schema_header()) + "\n")
+        f.write(json.dumps({"metric": "m1", "value": 1.5, "unit": "keys/sec",
+                            "vs_baseline": 2.0}) + "\n")
+        f.write("\n")  # blank lines tolerated
+        f.write(json.dumps({"metric": "m2", "value": 3, "unit": "rec/sec",
+                            "custom_extra": [1, 2]}) + "\n")
+    assert bench.check_artifact(str(p)) == []
+
+
+def test_check_artifact_flags_violations(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"metric": "ok", "value": 1.0, "unit": "u"}) + "\n")
+        f.write("not json at all\n")
+        f.write(json.dumps({"metric": "no_value", "unit": "u"}) + "\n")
+        f.write(json.dumps({"metric": 7, "value": 1.0, "unit": "u"}) + "\n")
+        f.write(json.dumps({"metric": "bad_extra", "value": 1.0, "unit": "u",
+                            "vs_baseline": "high"}) + "\n")
+        f.write(json.dumps(["a", "list"]) + "\n")
+        # bool must not satisfy "num" (bool is an int subclass in Python).
+        f.write(json.dumps({"metric": "boolval", "value": True, "unit": "u"})
+                + "\n")
+    errs = bench.check_artifact(str(p))
+    assert len(errs) == 6, errs
+    assert any("not JSON" in e for e in errs)
+    assert any("missing required 'value'" in e for e in errs)
+    assert any("'metric' is not of type 'str'" in e for e in errs)
+    assert any("'vs_baseline' is not of type 'num'" in e for e in errs)
+    assert any("not a JSON object" in e for e in errs)
+    assert any("'value' is not of type 'num'" in e for e in errs)
+
+
+def test_check_artifact_header_after_metrics_flagged(tmp_path):
+    p = tmp_path / "late.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"metric": "m", "value": 1.0, "unit": "u"}) + "\n")
+        f.write(json.dumps(bench._schema_header()) + "\n")
+    errs = bench.check_artifact(str(p))
+    assert any("schema header after metric lines" in e for e in errs)
+
+
+def test_check_artifact_missing_file():
+    errs = bench.check_artifact("/nonexistent/artifact.jsonl")
+    assert len(errs) == 1 and "unreadable" in errs[0]
+
+
+def test_check_main_exit_codes(tmp_path, capsys):
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps({"metric": "m", "value": 1.0, "unit": "u"})
+                    + "\n")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("nope\n")
+    assert bench._check_main([str(good)]) == 0
+    assert bench._check_main([str(good), str(bad)]) == 1
+    assert bench._check_main([]) == 2
+    out = capsys.readouterr().out
+    assert "OK" in out and "schema violations" in out
+
+
+def test_in_tree_artifacts_pass_schema_check():
+    """Tier-1 gate: every committed BENCH_*.jsonl artifact round-trips
+    against the schema (pre-header artifacts validate under the v0
+    default) — the driver-artifact contract, now machine-checkable."""
+    import glob
+
+    root = os.path.dirname(_BENCH)
+    artifacts = sorted(glob.glob(os.path.join(root, "BENCH_*.jsonl")))
+    assert artifacts, "no in-tree BENCH_*.jsonl artifacts found"
+    for art in artifacts:
+        assert bench.check_artifact(art) == [], art
