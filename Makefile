@@ -21,6 +21,14 @@ bench-exchange-smoke:  ## ring-vs-alltoall exchange A/B (uniform + zipf) on the 
 	$(PY) -m dsort_tpu.cli bench --exchange-ab --n 200000 --reps 2 \
 	--journal /tmp/dsort_bench_exchange_smoke.jsonl
 
+# Regression diff over versioned bench artifacts (tolerance ladder:
+# ok >= 0.95 > noise >= 0.80 > regression >= 0.50 > severe); exits 1 on
+# severe (STRICT=1: also on regression).  Backend-free.
+OLD ?= BENCH_r05_preview.jsonl
+NEW ?= BENCH_r06.jsonl
+bench-compare:  ## diff two bench artifacts: make bench-compare OLD=a NEW=b [STRICT=1]
+	$(PY) bench.py --compare $(OLD) $(NEW) $(if $(STRICT),--strict,)
+
 native:  ## build libdsort_native.so
 	$(MAKE) -C $(NATIVE)
 
@@ -38,4 +46,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-compare native tsan asan ubsan sanitize

@@ -172,6 +172,124 @@ def check_artifact(path: str) -> list[str]:
     return errors
 
 
+# -- artifact regression diff (`bench.py --compare OLD NEW`) -----------------
+#
+# The in-tree BENCH_*.jsonl artifacts are a trajectory; this is the tool
+# that reads it.  Metrics match by name; throughput-like units compare as
+# new/old ratios and classify on the tolerance ladder below.  Sub-unity
+# ratios up to `noise` are expected between sessions (the tunnel-jitter
+# doctrine in the module docstring); `regression`/`severe` mean a change
+# that needs an explanation in the PR that shipped it.
+
+#: (floor ratio, class) — first floor the ratio clears, scanning down.
+COMPARE_LADDER: tuple[tuple[float, str], ...] = (
+    (0.95, "ok"),
+    (0.80, "noise"),
+    (0.50, "regression"),
+    (0.0, "severe"),
+)
+
+#: Units where value is a rate (higher = better) and a ratio is meaningful.
+_RATE_UNITS = {"keys/sec", "rec/sec", "MB/s"}
+
+
+def classify_ratio(ratio: float) -> str:
+    for floor, label in COMPARE_LADDER:
+        if ratio >= floor:
+            return label
+    return "severe"
+
+
+def _artifact_metrics(path: str) -> dict[str, dict]:
+    """Metric lines of one artifact, keyed by metric name (summary/header
+    lines dropped; duplicate names keep their first occurrence, matching
+    the summary's disambiguation doctrine)."""
+    out: dict[str, dict] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(obj, dict) or "metric" not in obj:
+                continue
+            if obj["metric"] in ("summary", "compact_summary"):
+                continue
+            out.setdefault(obj["metric"], obj)
+    return out
+
+
+def compare_artifacts(old_path: str, new_path: str) -> list[dict]:
+    """Regression rows for every metric the two artifacts share.
+
+    Each row: ``{"metric", "unit", "old", "new", "ratio", "class"}`` for
+    rate units; non-rate units (ratios, counters) report ``class:"info"``.
+    Metrics present on only one side report as ``added``/``removed`` —
+    silently narrowing coverage is itself a regression signal.
+    """
+    old, new = _artifact_metrics(old_path), _artifact_metrics(new_path)
+    rows: list[dict] = []
+    for name in sorted(set(old) | set(new)):
+        o, n = old.get(name), new.get(name)
+        if o is None or n is None:
+            rows.append(
+                {"metric": name, "class": "added" if o is None else "removed"}
+            )
+            continue
+        row = {
+            "metric": name, "unit": n.get("unit"),
+            "old": o.get("value"), "new": n.get("value"),
+        }
+        # A zero/errored side makes the ratio meaningless — an error line's
+        # value is 0.0 by convention; call it out instead of dividing.
+        if "error" in o or "error" in n or not o.get("value"):
+            row["class"] = "error" if ("error" in o or "error" in n) else "info"
+        elif n.get("unit") in _RATE_UNITS and o.get("unit") == n.get("unit"):
+            ratio = float(n["value"]) / float(o["value"])
+            row["ratio"] = round(ratio, 3)
+            row["class"] = classify_ratio(ratio)
+        else:
+            row["class"] = "info"
+        rows.append(row)
+    return rows
+
+
+def _compare_main(argv: list[str]) -> int:
+    """``bench.py --compare OLD NEW [--strict]``: print rows, summarize.
+
+    Exit 1 on any ``severe`` row (``--strict``: also on ``regression``);
+    the ladder classes in between are reported, not fatal — session noise
+    must not turn CI red.  Backend-free, like ``--check``.
+    """
+    strict = "--strict" in argv
+    paths = [a for a in argv if a != "--strict"]
+    if len(paths) != 2:
+        print(
+            "usage: bench.py --compare OLD NEW [--strict]", file=sys.stderr
+        )
+        return 2
+    rows = compare_artifacts(paths[0], paths[1])
+    if not rows:
+        print(f"no metric lines found in {paths[0]} / {paths[1]}",
+              file=sys.stderr)
+        return 2
+    counts: dict[str, int] = {}
+    for row in rows:
+        counts[row["class"]] = counts.get(row["class"], 0) + 1
+        print(json.dumps(row), flush=True)
+    print(json.dumps({
+        "metric": "compare_summary",
+        "old": paths[0], "new": paths[1],
+        "classes": counts,
+        "ladder": [[f, c] for f, c in COMPARE_LADDER],
+    }), flush=True)
+    bad = counts.get("severe", 0) + (counts.get("regression", 0) if strict else 0)
+    return 1 if bad else 0
+
+
 def _ensure_responsive_backend() -> None:
     """Guard against a wedged accelerator runtime.
 
@@ -1144,4 +1262,6 @@ def _check_main(paths: list[str]) -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--check":
         sys.exit(_check_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--compare":
+        sys.exit(_compare_main(sys.argv[2:]))
     sys.exit(main())

@@ -85,6 +85,10 @@ def _load_config(args) -> SortConfig:
         job_over["exchange"] = args.exchange
     if getattr(args, "checkpoint_dir", None):
         job_over["checkpoint_dir"] = args.checkpoint_dir
+    if getattr(args, "tenant", None):
+        job_over["tenant"] = args.tenant
+    if getattr(args, "flight_dir", None):
+        job_over["flight_recorder_dir"] = args.flight_dir
     if job_over:
         cfg = dataclasses.replace(cfg, job=dataclasses.replace(cfg.job, **job_over))
     if mesh_over:
@@ -172,7 +176,7 @@ def _make_sorter(cfg: SortConfig, mode: str):
                     # time out and fall back, never block forever.
                     metrics.event(
                         "job_start", mode="fused", n_keys=len(data),
-                        job_id=job_id,
+                        job_id=job_id, tenant=cfg.job.tenant,
                     )
                     out = sched.run_bounded(
                         lambda: fused_sort_small(
@@ -271,21 +275,53 @@ def _make_sorter(cfg: SortConfig, mode: str):
                 "checkpoint; --checkpoint-dir/--job-id are ignored (use "
                 "spmd or taskpool mode for resumable jobs)"
             )
-        return lambda data, metrics, job_id=None: fused_sort_small(
-            data, cfg.job.local_kernel, metrics
-        )
+
+        def local_sorter(data, metrics, job_id=None):
+            # Journal the job boundaries here too: local mode has no
+            # scheduler to emit them, and without job_start/job_done the
+            # SLO tracker (obs.slo) cannot see local-mode jobs at all.
+            metrics.event(
+                "job_start", mode="local", n_keys=len(data), job_id=job_id,
+                tenant=cfg.job.tenant,
+            )
+            out = fused_sort_small(data, cfg.job.local_kernel, metrics)
+            metrics.event(
+                "job_done", n_keys=len(data), counters=dict(metrics.counters)
+            )
+            return out
+
+        return local_sorter
     raise SystemExit(f"unknown mode {mode!r}")
 
 
 def _run_one(
-    sorter, in_path: str, out_path: str, dtype, job_id=None, journal=None
+    sorter, in_path: str, out_path: str, dtype, job_id=None, journal=None,
+    telemetry=None,
 ) -> None:
     from dsort_tpu.data.ingest import read_ints_file, write_ints_file
 
     t0 = time.perf_counter()
     data = read_ints_file(in_path, dtype=dtype)
     metrics = Metrics(journal=journal)
-    out = sorter(data, metrics, job_id=job_id)
+    if telemetry is not None:
+        telemetry.attach(metrics)
+    try:
+        out = sorter(data, metrics, job_id=job_id)
+    except BaseException as e:
+        # The schedulers emit job_failed only on their CLEAN failure paths
+        # (all workers dead); any other escape after job_start would leave
+        # the job open forever on the telemetry side — jobs_in_flight
+        # inflated for the rest of a serve session.  A duplicate
+        # job_failed (scheduler already emitted one) is a no-op for the
+        # taps, so closing unconditionally here is safe.
+        metrics.event(
+            "job_failed", reason=(str(e).splitlines() or [repr(e)])[0][:120],
+            counters=dict(metrics.counters),
+        )
+        raise
+    # The 'fetched' SLO stage boundary: on the relay path the sorted keys
+    # are host-resident exactly here (obs.slo — sorted_to_fetched).
+    metrics.event("result_fetch", n_keys=len(out))
     write_ints_file(out_path, out)
     dt = time.perf_counter() - t0
     log.info(
@@ -405,7 +441,13 @@ def cmd_run(args) -> int:
 
 
 def cmd_serve(args) -> int:
-    """The reference's interactive job loop (server.c:160-167 workflow)."""
+    """The reference's interactive job loop (server.c:160-167 workflow).
+
+    ``--metrics-port`` additionally exposes the live telemetry endpoint
+    (`obs.MetricsServer`): Prometheus text at ``/metrics`` (counters,
+    phase timings, queue depth, per-tenant SLO quantiles), JSON at
+    ``/json``; render a scrape with ``dsort top``.
+    """
     cfg = _load_config(args)
     sorter = _make_sorter(cfg, args.mode)
     dtype = np.dtype(cfg.job.key_dtype)
@@ -418,6 +460,25 @@ def cmd_serve(args) -> int:
             "serve mode ignores --job-id: each input file checkpoints under "
             "its own name"
         )
+    telemetry = server = None
+    if getattr(args, "metrics_port", None) is not None:
+        from dsort_tpu.obs import MetricsServer, Telemetry
+
+        telemetry = Telemetry()
+        # The REPL admits one job at a time — depth 0 until the async
+        # admission queue (ROADMAP item 1) drives this gauge for real.
+        telemetry.set_gauge("queue_depth", 0)
+        server = MetricsServer(telemetry, port=args.metrics_port)
+        log.info("metrics endpoint: %s (render with `dsort top %s`)",
+                 server.url, server.url)
+    try:
+        return _serve_loop(args, cfg, sorter, dtype, journal, telemetry)
+    finally:
+        if server is not None:
+            server.close()
+
+
+def _serve_loop(args, cfg, sorter, dtype, journal, telemetry) -> int:
     while True:
         try:
             line = input("Enter the filename to sort (or 'exit' to quit): ")
@@ -438,7 +499,7 @@ def cmd_serve(args) -> int:
                 _job_id_for(name, None) if cfg.job.checkpoint_dir else None
             )
             _run_one(sorter, name, args.output or cfg.output_path, dtype,
-                     job_id=jid, journal=journal)
+                     job_id=jid, journal=journal, telemetry=telemetry)
         except Exception as e:  # a bad job must not kill the server
             log.error("job failed: %s", e)
         finally:
@@ -1092,17 +1153,28 @@ def cmd_validate(args) -> int:
 
 
 def cmd_report(args) -> int:
-    """Render a job's event journal: human timeline + phase/counter tables.
+    """Render event journal(s): human timeline + phase/counter tables.
 
-    The second consumer of the journal (`dsort run --journal out.jsonl`
-    writes it); ``--chrome-trace`` additionally exports a Perfetto
-    ``trace_event`` file that loads next to a ``jax.profiler`` capture.
+    With several journals (``dsort report --merge a.jsonl b.jsonl`` — the
+    ``--merge`` flag is implied by passing more than one) the per-process
+    traces merge into ONE aligned fleet timeline (`obs.merge`: each
+    journal's monotonic base is rebased via its wall<->mono offset, every
+    record tagged with its source).  Torn or malformed lines are skipped
+    and counted, never fatal.  ``--chrome-trace`` additionally exports a
+    Perfetto ``trace_event`` file (one pid per source journal, one tid per
+    job) that loads next to a ``jax.profiler`` capture.
     """
     import json as _json
 
-    from dsort_tpu.utils.events import EventLog, format_report, to_chrome_trace
+    from dsort_tpu.obs.merge import merge_journals, read_journal
+    from dsort_tpu.utils.events import format_report, to_chrome_trace
 
-    records = EventLog.read_jsonl(args.journal)
+    if len(args.journal) > 1 or args.merge:
+        records, skipped = merge_journals(args.journal)
+    else:
+        records, skipped = read_journal(args.journal[0])
+    if skipped:
+        log.warning("skipped %d malformed journal line(s)", skipped)
     print(format_report(records), end="")
     if args.chrome_trace:
         with open(args.chrome_trace, "w", encoding="utf-8") as f:
@@ -1110,6 +1182,33 @@ def cmd_report(args) -> int:
         log.info("chrome trace written to %s (load in Perfetto / "
                  "chrome://tracing)", args.chrome_trace)
     return 0
+
+
+def cmd_top(args) -> int:
+    """One-shot (or ``--interval`` refreshing) console view of a metrics
+    endpoint scrape — the operator's `top` for a running ``dsort serve
+    --metrics-port`` session."""
+    from dsort_tpu.obs.top import fetch_metrics, render_top
+
+    shown = 0
+    while True:
+        try:
+            parsed = fetch_metrics(args.url)
+        except (OSError, ValueError) as e:
+            log.error("scrape of %s failed: %s", args.url, e)
+            return 1
+        if shown:
+            print()  # separate refreshes; no terminal tricks needed
+        print(f"dsort top — {args.url}")
+        print(render_top(parsed), end="")
+        shown += 1
+        if args.interval is None or (args.count and shown >= args.count):
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            print()
+            return 0
 
 
 def _project_root(start: str) -> str:
@@ -1249,6 +1348,13 @@ def main(argv=None) -> int:
         p.add_argument("--journal",
                        help="write the job's structured event journal "
                             "(JSONL) here; render with `dsort report`")
+        p.add_argument("--tenant",
+                       help="tenant label on this job's events and SLO "
+                            "histograms (default 'default')")
+        p.add_argument("--flight-dir",
+                       help="fault flight recorder directory: any recovery "
+                            "path dumps a postmortem bundle here "
+                            "(ring + config + mesh state + counters)")
         p.add_argument("-o", "--output")
 
     p = sub.add_parser("run", help="sort one file")
@@ -1264,6 +1370,10 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("serve", help="interactive job loop (reference REPL)")
     common(p)
+    p.add_argument("--metrics-port", type=int,
+                   help="expose the live telemetry endpoint on this port "
+                        "(0 = ephemeral; Prometheus text at /metrics, "
+                        "JSON at /json; view with `dsort top`)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("bench", help="throughput benchmark (one JSON line)")
@@ -1341,12 +1451,30 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_validate)
 
     p = sub.add_parser(
-        "report", help="render an event journal (timeline + phases/counters)"
+        "report", help="render event journal(s) (timeline + phases/counters)"
     )
-    p.add_argument("journal", help="journal JSONL from `dsort run --journal`")
+    p.add_argument("journal", nargs="+",
+                   help="journal JSONL(s) from `--journal`; several merge "
+                        "into one clock-aligned fleet timeline")
+    p.add_argument("--merge", action="store_true",
+                   help="merge the journals into one aligned trace "
+                        "(implied when more than one is given)")
     p.add_argument("--chrome-trace",
-                   help="also export a Perfetto trace_event JSON here")
+                   help="also export a Perfetto trace_event JSON here "
+                        "(one pid per source journal, one tid per job)")
     p.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser(
+        "top", help="console view of a running serve's metrics endpoint"
+    )
+    p.add_argument("url", nargs="?",
+                   default="http://127.0.0.1:9100/metrics",
+                   help="metrics endpoint URL (default %(default)s)")
+    p.add_argument("--interval", type=float,
+                   help="refresh every N seconds (default: one-shot)")
+    p.add_argument("--count", type=int,
+                   help="stop after N refreshes (with --interval)")
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser(
         "lint",

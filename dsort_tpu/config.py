@@ -134,6 +134,17 @@ class JobConfig:
     exec_allowance_floor_s: float = 30.0
     exec_allowance_keys_per_s: float = 1e6
     checkpoint_dir: str | None = None  # persist sorted shards for partial recovery
+    # Telemetry plane (dsort_tpu.obs):
+    # Tenant label for the SLO histograms (per-tenant p50/p95/p99 of
+    # admit->dispatch->sorted->fetched) — the admission-control signal the
+    # multi-tenant serving layer (ROADMAP item 1) keys on.  Rides every
+    # job_start event; constrained to Prometheus-label-safe characters.
+    tenant: str = "default"
+    # When set, the owning scheduler keeps a bounded ring of recent events
+    # and dumps a postmortem bundle here whenever a recovery path fires
+    # (obs.flight.FlightRecorder).
+    flight_recorder_dir: str | None = None
+    flight_ring_size: int = 256     # events retained in the recorder ring
 
     def __post_init__(self) -> None:
         import jax
@@ -177,6 +188,17 @@ class JobConfig:
                 "exec_allowance_keys_per_s must be > 0, got "
                 f"{self.exec_allowance_keys_per_s}"
             )
+        import re
+
+        if not re.fullmatch(r"[A-Za-z0-9._-]+", self.tenant or ""):
+            raise ConfigError(
+                "tenant must match [A-Za-z0-9._-]+ (it becomes a metrics "
+                f"label), got {self.tenant!r}"
+            )
+        if self.flight_ring_size < 1:
+            raise ConfigError(
+                f"flight_ring_size must be >= 1, got {self.flight_ring_size}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,7 +219,8 @@ class SortConfig:
         Accepts the reference's exact keys (``SERVER_IP``, ``SERVER_PORT``)
         plus framework keys (``NUM_WORKERS``, ``KEY_DTYPE``, ``OVERSAMPLE``,
         ``CAPACITY_FACTOR``, ``PAYLOAD_BYTES``, ``HEARTBEAT_TIMEOUT_S``,
-        ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``, ``EXCHANGE``).
+        ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``, ``EXCHANGE``,
+        ``TENANT``, ``FLIGHT_DIR``).
         """
         def geti(key: str, default: int | None) -> int | None:
             return int(m[key]) if key in m else default
@@ -222,6 +245,8 @@ class SortConfig:
                 m.get("HEARTBEAT_TIMEOUT_S", JobConfig.heartbeat_timeout_s)
             ),
             checkpoint_dir=m.get("CHECKPOINT_DIR") or None,
+            tenant=m.get("TENANT", JobConfig.tenant),
+            flight_recorder_dir=m.get("FLIGHT_DIR") or None,
         )
         return cls(
             mesh=mesh,

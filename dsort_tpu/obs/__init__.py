@@ -1,0 +1,50 @@
+"""Fleet telemetry plane (the PR 6 observability tentpole).
+
+PR 1 made every execution mode journal typed events; this package turns
+those journals — and the live event stream behind them — into an
+operable telemetry surface, four pillars:
+
+- `obs.merge`: join per-process/per-host JSONL journals into ONE global
+  trace, aligning each journal's monotonic clock base via the (wall, mono)
+  pairs every event already carries (``clock_sync`` events bless one pair
+  per process explicitly).  Feeds ``dsort report --merge`` and the
+  multi-lane Chrome-trace export.
+- `obs.telemetry` + `obs.server`: a live metrics registry (counters, phase
+  timings, queue depth, jobs in flight, per-tenant SLO histograms) fed by
+  `Metrics` event taps, snapshotted in Prometheus text format over a
+  stdlib HTTP endpoint (``dsort serve --metrics-port`` /
+  ``MetricsServer``); ``dsort top`` renders a scrape as a console view.
+- `obs.slo`: streaming per-job latency histograms
+  (admit -> dispatch -> sorted -> fetched) keyed by the ``tenant=`` label
+  `JobConfig` threads — ROADMAP item 1's admission-control signal.
+- `obs.flight`: a bounded ring of recent events per scheduler that dumps a
+  postmortem bundle (ring, config, mesh state, counters, the recovery
+  path that fired) whenever any recovery path fires.
+"""
+
+from dsort_tpu.obs.flight import (  # noqa: F401
+    BUNDLE_SCHEMA_KEYS,
+    RECOVERY_EVENTS,
+    FlightRecorder,
+)
+from dsort_tpu.obs.histogram import LatencyHistogram  # noqa: F401
+from dsort_tpu.obs.merge import merge_journals, merge_records, read_journal  # noqa: F401
+from dsort_tpu.obs.server import MetricsServer  # noqa: F401
+from dsort_tpu.obs.slo import SLO_QUANTILES, SLO_STAGES, slo_from_journal  # noqa: F401
+from dsort_tpu.obs.telemetry import Telemetry, parse_prometheus_text  # noqa: F401
+
+__all__ = [
+    "BUNDLE_SCHEMA_KEYS",
+    "FlightRecorder",
+    "LatencyHistogram",
+    "MetricsServer",
+    "RECOVERY_EVENTS",
+    "SLO_QUANTILES",
+    "SLO_STAGES",
+    "Telemetry",
+    "merge_journals",
+    "merge_records",
+    "parse_prometheus_text",
+    "read_journal",
+    "slo_from_journal",
+]

@@ -1,0 +1,109 @@
+"""Journal aggregation: many per-process JSONL journals -> ONE global trace.
+
+A multi-host run writes one journal per process, each stamped on its OWN
+monotonic clock (arbitrary base per process) plus wall time.  Merging by
+wall time alone jitters (NTP steps, coarse wall resolution mid-run);
+merging by mono alone is meaningless across processes.  Every event already
+carries BOTH stamps, so each journal's wall<->mono offset is recoverable:
+
+    offset_j = median over events of (t - mono)
+
+``clock_sync`` events (one per process at job start, one per native
+coordinator drain) bless a dedicated pair for exactly this purpose and are
+preferred when present.  The merger rebases every journal's ``mono`` onto
+journal 0's monotonic base via these offsets, tags each record with its
+source index (``src``), sorts, and reseqs — one coherent fleet timeline
+that `format_report` and `to_chrome_trace` (one pid per source, one tid
+per job) consume unchanged.
+
+Torn lines (a crashed process mid-write), non-JSON garbage and records
+missing their stamps are SKIPPED AND COUNTED, never raised: a journal is a
+diagnostic artifact and a postmortem must render whatever survived.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+
+from dsort_tpu.utils.logging import get_logger
+
+log = get_logger("obs.merge")
+
+#: Keys a record must carry (with numeric stamps) to be mergeable.
+_REQUIRED = ("type", "t", "mono")
+
+
+def read_journal(path: str) -> tuple[list[dict], int]:
+    """Tolerantly read one JSONL journal: ``(records, skipped_lines)``."""
+    records: list[dict] = []
+    skipped = 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if not isinstance(obj, dict) or not all(
+                k in obj for k in _REQUIRED
+            ) or not all(
+                isinstance(obj[k], (int, float)) for k in ("t", "mono")
+            ):
+                skipped += 1
+                continue
+            records.append(obj)
+    if skipped:
+        log.warning("journal %s: skipped %d malformed line(s)", path, skipped)
+    return records, skipped
+
+
+def wall_mono_offset(records: list[dict]) -> float:
+    """One journal's wall-minus-mono offset (``clock_sync`` pairs preferred,
+    median over all events otherwise — robust to a few torn stamps)."""
+    if not records:
+        return 0.0
+    pairs = [
+        r["t"] - r["mono"] for r in records if r["type"] == "clock_sync"
+    ] or [r["t"] - r["mono"] for r in records]
+    return float(statistics.median(pairs))
+
+
+def merge_records(journals: list[list[dict]]) -> list[dict]:
+    """Merge per-journal record lists into one aligned, re-sequenced trace.
+
+    Journal 0's monotonic base is the reference frame; every other
+    journal's ``mono`` is shifted by the difference of the wall<->mono
+    offsets, so durations WITHIN a journal are exact (mono-derived) and
+    placement ACROSS journals is wall-accurate.  Each record gains
+    ``src`` (its journal index); the merged sequence is time-ordered and
+    ``seq`` is rewritten to the global order.
+    """
+    base = wall_mono_offset(journals[0]) if journals else 0.0
+    out: list[dict] = []
+    for src, recs in enumerate(journals):
+        if not recs:
+            continue
+        shift = wall_mono_offset(recs) - base
+        for r in recs:
+            r = dict(r)
+            r["src"] = src
+            r["mono"] = round(r["mono"] + shift, 6)
+            out.append(r)
+    out.sort(key=lambda r: (r["mono"], r.get("t", 0.0), r.get("seq", 0)))
+    for i, r in enumerate(out):
+        r["seq"] = i
+    return out
+
+
+def merge_journals(paths: list[str]) -> tuple[list[dict], int]:
+    """Read + merge journal files: ``(merged_records, skipped_lines)``."""
+    journals, skipped = [], 0
+    for p in paths:
+        recs, s = read_journal(str(p))
+        journals.append(recs)
+        skipped += s
+    return merge_records(journals), skipped

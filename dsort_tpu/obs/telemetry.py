@@ -1,0 +1,246 @@
+"""Live metrics registry + Prometheus text snapshot (pillar 2).
+
+One `Telemetry` instance aggregates a session's observable state across
+jobs — counter totals, phase wall time, job outcomes, jobs in flight, an
+operator-settable gauge set (queue depth), and the per-tenant SLO
+histograms (`obs.slo`).  It is fed by `Metrics` event taps: `attach` a
+Telemetry to any `Metrics` and every event that job emits flows in live,
+with the journal's own timestamps.
+
+The snapshot renders in the Prometheus text exposition format (0.0.4) so
+any scraper — or the in-tree minimal parser `parse_prometheus_text`, which
+the tier-1 serve-smoke gate round-trips through — can consume it; the
+stdlib HTTP endpoint lives in `obs.server`, the console view in
+``dsort top``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+from dsort_tpu.obs.histogram import LatencyHistogram
+from dsort_tpu.obs.slo import SLO_QUANTILES, SloStateMachine
+from dsort_tpu.utils.events import COUNTERS
+
+
+class Telemetry:
+    """Session-wide aggregate of counters, phases, gauges and SLO stages."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = defaultdict(int)
+        self._phase_s: dict[str, float] = defaultdict(float)
+        self._jobs: dict[tuple[str, str], int] = defaultdict(int)
+        self._in_flight = 0
+        self._gauges: dict[str, float] = {"queue_depth": 0.0}
+        self._slo: dict[tuple[str, str], LatencyHistogram] = {}
+
+    # -- ingestion ---------------------------------------------------------
+
+    def attach(self, metrics) -> None:
+        """Tap a `Metrics` instance so its events feed this registry.
+
+        Idempotent per (metrics, telemetry) pair — schedulers and the CLI
+        may both attach the same pair.
+        """
+        for tap in metrics.taps:
+            if isinstance(tap, _TelemetryTap) and tap.telemetry is self:
+                return
+        metrics.taps.append(_TelemetryTap(self))
+
+    def observe_stage(self, tenant: str, stage: str, seconds: float) -> None:
+        key = (str(tenant), str(stage))
+        with self._lock:
+            h = self._slo.get(key)
+            if h is None:
+                h = self._slo[key] = LatencyHistogram()
+        h.observe(seconds)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[str(name)] = float(value)
+
+    def _job_started(self) -> None:
+        with self._lock:
+            self._in_flight += 1
+
+    def _job_finished(self, tenant: str, outcome: str) -> None:
+        with self._lock:
+            self._in_flight = max(self._in_flight - 1, 0)
+            self._jobs[(str(tenant), str(outcome))] += 1
+
+    def _absorb_counters(self, delta: dict) -> None:
+        with self._lock:
+            for k, v in delta.items():
+                if isinstance(v, (int, float)) and v:
+                    self._counters[str(k)] += int(v)
+
+    def _absorb_phase(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            self._phase_s[str(phase)] += float(seconds)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able full state (the ``/json`` endpoint + ``dsort top``)."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "phase_seconds": {
+                    k: round(v, 6) for k, v in self._phase_s.items()
+                },
+                "jobs": {
+                    f"{t}/{o}": n for (t, o), n in self._jobs.items()
+                },
+                "jobs_in_flight": self._in_flight,
+                "gauges": dict(self._gauges),
+                "slo": {
+                    f"{t}/{s}": h.snapshot() for (t, s), h in self._slo.items()
+                },
+            }
+
+    def render_prometheus(self) -> str:
+        """The Prometheus text exposition snapshot (scrape body)."""
+        with self._lock:
+            counters = dict(self._counters)
+            phases = dict(self._phase_s)
+            jobs = dict(self._jobs)
+            in_flight = self._in_flight
+            gauges = dict(self._gauges)
+            slo = dict(self._slo)
+        lines = [
+            "# HELP dsort_counter_total Registered framework counters "
+            "(utils.events.COUNTERS).",
+            "# TYPE dsort_counter_total counter",
+        ]
+        # EVERY registered counter renders (0 when never bumped): scrape
+        # series must not appear and vanish with job mix.
+        for name in sorted(set(COUNTERS) | set(counters)):
+            lines.append(
+                f'dsort_counter_total{{name="{name}"}} '
+                f"{counters.get(name, 0)}"
+            )
+        lines.append("# TYPE dsort_phase_seconds_total counter")
+        for phase in sorted(phases):
+            lines.append(
+                f'dsort_phase_seconds_total{{phase="{phase}"}} '
+                f"{phases[phase]:.6f}"
+            )
+        lines.append("# TYPE dsort_jobs_total counter")
+        for (tenant, outcome) in sorted(jobs):
+            lines.append(
+                f'dsort_jobs_total{{tenant="{tenant}",outcome="{outcome}"}} '
+                f"{jobs[(tenant, outcome)]}"
+            )
+        lines.append("# TYPE dsort_jobs_in_flight gauge")
+        lines.append(f"dsort_jobs_in_flight {in_flight}")
+        for name in sorted(gauges):
+            metric = f"dsort_{name}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {gauges[name]:g}")
+        lines.append(
+            "# HELP dsort_job_stage_seconds Per-tenant SLO stage latency "
+            "quantiles (obs.slo)."
+        )
+        lines.append("# TYPE dsort_job_stage_seconds summary")
+        for (tenant, stage) in sorted(slo):
+            h = slo[(tenant, stage)]
+            labels = f'tenant="{tenant}",stage="{stage}"'
+            for q in SLO_QUANTILES:
+                lines.append(
+                    f'dsort_job_stage_seconds{{{labels},quantile="{q}"}} '
+                    f"{h.quantile(q):.6g}"
+                )
+            lines.append(
+                f"dsort_job_stage_seconds_count{{{labels}}} {h.count}"
+            )
+            lines.append(
+                f"dsort_job_stage_seconds_sum{{{labels}}} {h.sum:.6f}"
+            )
+        return "\n".join(lines) + "\n"
+
+
+class _TelemetryTap:
+    """Per-`Metrics` event tap feeding one `Telemetry`.
+
+    Owns the per-job SLO state machine and the counter high-water mark for
+    its Metrics instance (``job_done`` carries CUMULATIVE counters, so the
+    registry must absorb deltas or a fused-fallback double ``job_done``
+    would double-count).
+    """
+
+    def __init__(self, telemetry: Telemetry):
+        self.telemetry = telemetry
+        self._slo = SloStateMachine(telemetry.observe_stage)
+        self._last_counters: dict = {}
+        self._started: set = set()
+
+    def observe(self, etype: str, fields: dict, mono: float, metrics) -> None:
+        tel = self.telemetry
+        job = fields.get("job")
+        if etype == "job_start" and job not in self._started:
+            self._started.add(job)
+            tel._job_started()
+        elif etype in ("job_done", "job_failed"):
+            if job in self._started:
+                self._started.discard(job)
+                tenant = self._slo.tenant_of(job)
+                tel._job_finished(
+                    tenant, "done" if etype == "job_done" else "failed"
+                )
+            c = fields.get("counters")
+            if isinstance(c, dict):
+                tel._absorb_counters(
+                    {
+                        k: v - self._last_counters.get(k, 0)
+                        for k, v in c.items()
+                    }
+                )
+                self._last_counters = dict(c)
+        elif etype == "phase_end":
+            sec = fields.get("seconds")
+            if isinstance(sec, (int, float)):
+                tel._absorb_phase(fields.get("phase", "?"), sec)
+        # The SLO machine consumes job_start BEFORE the outcome branches
+        # above pop its state, and job_done after — step() order matters
+        # only relative to its own reads, so one call at the end suffices.
+        self._slo.step(etype, fields, mono)
+
+
+def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:
+    """Minimal Prometheus text parser: the tier-1 scrape round-trip.
+
+    Returns ``{(metric_name, ((label, value), ...)): float}`` with labels
+    sorted.  Covers exactly the subset `Telemetry.render_prometheus` emits
+    (no escapes inside label values, no timestamps) and raises ValueError
+    on anything that does not parse — a torn scrape must fail the gate, not
+    vanish.
+    """
+    out: dict[tuple[str, tuple], float] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            raise ValueError(f"unparseable metric line: {raw!r}")
+        value = float(value_part)  # ValueError propagates
+        labels: tuple = ()
+        name = name_part.strip()
+        if name.endswith("}"):
+            name, _, label_body = name.partition("{")
+            label_body = label_body[:-1]
+            pairs = []
+            for item in label_body.split(","):
+                if not item:
+                    continue
+                k, eq, v = item.partition("=")
+                if eq != "=" or not (v.startswith('"') and v.endswith('"')):
+                    raise ValueError(f"unparseable labels: {raw!r}")
+                pairs.append((k, v[1:-1]))
+            labels = tuple(sorted(pairs))
+        if not name or any(ch in name for ch in "{} "):
+            raise ValueError(f"unparseable metric name: {raw!r}")
+        out[(name, labels)] = value
+    return out

@@ -1,0 +1,85 @@
+"""``dsort top``: render one metrics scrape as a console snapshot.
+
+Scrapes the `obs.server` endpoint (stdlib urllib), parses the Prometheus
+text through the same minimal parser the tier-1 gate uses, and renders the
+operator view: jobs in flight / queue depth, per-tenant job outcomes and
+SLO stage quantiles, phase wall time, and the nonzero counters.  One-shot
+by default; ``--interval`` refreshes until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+from dsort_tpu.obs.slo import SLO_QUANTILES
+from dsort_tpu.obs.telemetry import parse_prometheus_text
+
+
+def fetch_metrics(url: str, timeout: float = 5.0) -> dict:
+    """Scrape + parse one snapshot from a ``/metrics`` URL."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return parse_prometheus_text(resp.read().decode("utf-8"))
+
+
+def _labeled(parsed: dict, metric: str) -> list[tuple[dict, float]]:
+    return [
+        (dict(labels), value)
+        for (name, labels), value in sorted(parsed.items())
+        if name == metric
+    ]
+
+
+def render_top(parsed: dict) -> str:
+    """The console snapshot for one parsed scrape."""
+    lines = []
+    in_flight = parsed.get(("dsort_jobs_in_flight", ()), 0.0)
+    queue = parsed.get(("dsort_queue_depth", ()), 0.0)
+    lines.append(
+        f"jobs in flight: {int(in_flight)}    queue depth: {int(queue)}"
+    )
+    jobs = _labeled(parsed, "dsort_jobs_total")
+    if jobs:
+        lines.append("jobs:")
+        for labels, value in jobs:
+            lines.append(
+                f"  {labels.get('tenant', '?'):<16} "
+                f"{labels.get('outcome', '?'):<8} {int(value):>8}"
+            )
+    # SLO table: one row per (tenant, stage) with its quantile columns.
+    slo: dict[tuple[str, str], dict] = {}
+    for labels, value in _labeled(parsed, "dsort_job_stage_seconds"):
+        key = (labels.get("tenant", "?"), labels.get("stage", "?"))
+        slo.setdefault(key, {})[labels.get("quantile", "?")] = value
+    counts = {
+        (labels.get("tenant", "?"), labels.get("stage", "?")): value
+        for labels, value in _labeled(parsed, "dsort_job_stage_seconds_count")
+    }
+    if slo:
+        qcols = "".join(f"{f'p{int(q * 100)}':>10}" for q in SLO_QUANTILES)
+        lines.append(f"slo (ms): {'tenant/stage':<38}{qcols}{'count':>8}")
+        for (tenant, stage) in sorted(slo):
+            row = slo[(tenant, stage)]
+            cells = "".join(
+                f"{row.get(str(q), 0.0) * 1e3:>10.2f}" for q in SLO_QUANTILES
+            )
+            lines.append(
+                f"  {tenant + '/' + stage:<44}{cells}"
+                f"{int(counts.get((tenant, stage), 0)):>8}"
+            )
+    phases = _labeled(parsed, "dsort_phase_seconds_total")
+    if phases:
+        lines.append("phase wall time:")
+        for labels, value in phases:
+            lines.append(
+                f"  {labels.get('phase', '?'):<20} {value * 1e3:>12.3f} ms"
+            )
+    counters = [
+        (labels.get("name", "?"), value)
+        for labels, value in _labeled(parsed, "dsort_counter_total")
+        if value
+    ]
+    if counters:
+        lines.append("counters (nonzero):")
+        for name, value in counters:
+            lines.append(f"  {name:<28} {int(value):>10}")
+    return "\n".join(lines) + "\n"

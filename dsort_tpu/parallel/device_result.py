@@ -193,6 +193,10 @@ class DeviceSortResult:
                 f"device shard lengths sum to {off}, expected {self.n} keys"
             )
         self._host = out
+        if self._metrics is not None:
+            # The 'fetched' SLO stage boundary: the sorted result crossed
+            # to the host (obs.slo — sorted_to_fetched).
+            self._metrics.event("result_fetch", n_keys=self.n)
         return out
 
     def consume(self, fn, donate: bool = True):

@@ -259,6 +259,27 @@ def _per_host_egress(out_counts, arrays):
     return outs, offset
 
 
+def _attach_mh_observers(job, metrics) -> None:
+    """Per-call flight recorder for the multi-host driver (no scheduler
+    object owns this path, so the recorder attaches per job call)."""
+    if not job.flight_recorder_dir:
+        return
+    from dsort_tpu.obs.flight import FlightRecorder
+
+    pid, nprocs = jax.process_index(), jax.process_count()
+    FlightRecorder(
+        job.flight_recorder_dir,
+        ring_size=job.flight_ring_size,
+        state_fn=lambda: {
+            "mode": "multihost",
+            "process": pid,
+            "processes": nprocs,
+            "local_devices": len(jax.local_devices()),
+        },
+        config=job,
+    ).attach(metrics)
+
+
 def sort_local_shards(
     local_data, job=None, axis_name: str = "w", metrics=None,
     job_id: str | None = None,
@@ -306,10 +327,14 @@ def sort_local_shards(
         return out, off
     job = job or JobConfig()
     metrics = metrics if metrics is not None else Metrics()
+    _attach_mh_observers(job, metrics)
     metrics.event(
         "job_start", mode="multihost", n_keys=len(local_data), job_id=job_id,
-        process=jax.process_index(),
+        process=jax.process_index(), tenant=job.tenant,
     )
+    # The journal merger's alignment handshake: one blessed (wall, mono)
+    # pair per process journal (obs.merge.wall_mono_offset prefers these).
+    metrics.event("clock_sync", process=jax.process_index())
     if job.checkpoint_dir and job_id:
         out = _sort_local_shards_ckpt(
             local_data, job, axis_name, metrics, job_id
@@ -710,10 +735,12 @@ def sort_local_records(
         )
     job = job or JobConfig()
     metrics = metrics if metrics is not None else Metrics()
+    _attach_mh_observers(job, metrics)
     metrics.event(
         "job_start", mode="multihost_kv", n_keys=len(keys), job_id=job_id,
-        process=jax.process_index(),
+        process=jax.process_index(), tenant=job.tenant,
     )
+    metrics.event("clock_sync", process=jax.process_index())
     if job.checkpoint_dir and job_id:
         out = _sort_local_records_ckpt(
             keys, payload, secondary, job, axis_name, metrics, job_id

@@ -76,6 +76,12 @@ class NativeCoordinator:
         recs = native.coord_drain_events(self._h)
         journal = getattr(metrics, "journal", None)
         if journal is not None:
+            if recs:
+                # Alignment handshake for the journal merger (obs.merge):
+                # the drained native records carry the coordinator's steady
+                # clock, and this emit's own (wall, mono) pair anchors that
+                # base explicitly in the same journal.
+                metrics.event("clock_sync", source="native_coordinator")
             for r in recs:
                 fields = {
                     k: v for k, v in r.items() if k not in ("type", "t", "mono")

@@ -189,6 +189,24 @@ def _size_bucket(n: int) -> int:
     return 1 << max(int(n - 1).bit_length(), 0) if n > 1 else 1
 
 
+def _make_flight_recorder(job: JobConfig, state_fn):
+    """A `FlightRecorder` when the job configures one, else None.
+
+    Shared by both schedulers so the bundle contract (config snapshot,
+    ring size, state callback) can never drift between execution modes.
+    """
+    if not job.flight_recorder_dir:
+        return None
+    from dsort_tpu.obs.flight import FlightRecorder
+
+    return FlightRecorder(
+        job.flight_recorder_dir,
+        ring_size=job.flight_ring_size,
+        state_fn=state_fn,
+        config=job,
+    )
+
+
 class Scheduler:
     """Task-pool scheduler: shard dispatch, liveness, reassignment, merge."""
 
@@ -210,6 +228,14 @@ class Scheduler:
         # attempt (a revived worker or an odd last shard reassigned to a new
         # device still pays the full 30-150 s compile — ADVICE r3).
         self._warm_shapes: set = set()
+        self.flight = _make_flight_recorder(
+            self.job,
+            lambda: {
+                "mode": "taskpool",
+                "workers": self.executor.num_workers,
+                "live": self.table.live_workers(),
+            },
+        )
 
     def _warm_key(self, worker: int, shard: np.ndarray) -> tuple:
         return (
@@ -391,10 +417,13 @@ class Scheduler:
             # merge only ever see order-preserving uints.
             return sort_float_keys_via_uint(self.run_job, data, metrics, job_id)
         metrics = metrics if metrics is not None else Metrics()
+        if self.flight is not None:
+            self.flight.attach(metrics)
         timer = PhaseTimer(metrics)
         w = self.executor.num_workers
         metrics.event(
-            "job_start", mode="taskpool", n_keys=len(data), job_id=job_id
+            "job_start", mode="taskpool", n_keys=len(data), job_id=job_id,
+            tenant=self.job.tenant,
         )
         self.table.revive_all()  # server.c:222,278
         ckpt = None
@@ -464,12 +493,25 @@ class SpmdScheduler:
         job: JobConfig | None = None,
         injector: FaultInjector | None = None,
         axis_name: str = "w",
+        telemetry=None,
     ):
         self.devices = list(devices) if devices is not None else jax.devices()
         self.job = job or JobConfig()
         self.injector = injector
         self.axis = axis_name
+        #: Optional `obs.Telemetry`: when set, every job's Metrics is tapped
+        #: so the live metrics endpoint (obs.MetricsServer) sees this
+        #: scheduler's counters, phases and per-tenant SLO stages.
+        self.telemetry = telemetry
         self.table = WorkerTable(len(self.devices), self.job.heartbeat_timeout_s)
+        self.flight = _make_flight_recorder(
+            self.job,
+            lambda: {
+                "mode": "spmd",
+                "devices": [d.id for d in self.devices],
+                "live": self.table.live_workers(),
+            },
+        )
         self._sorters: dict[tuple, object] = {}  # device-id set -> SampleSort
         # (lane key, size bucket) combos that completed once: their compiled
         # executables exist, so later waits drop the compile grace.
@@ -911,8 +953,13 @@ class SpmdScheduler:
                 self.sort, data, metrics, job_id, exchange=exchange
             )
         metrics = metrics if metrics is not None else Metrics()
+        if self.flight is not None:
+            self.flight.attach(metrics)
+        if self.telemetry is not None:
+            self.telemetry.attach(metrics)
         metrics.event(
-            "job_start", mode="spmd", n_keys=len(data), job_id=job_id
+            "job_start", mode="spmd", n_keys=len(data), job_id=job_id,
+            tenant=self.job.tenant,
         )
         self.table.revive_all()
         ckpt = None

@@ -67,6 +67,13 @@ EVENT_TYPES: dict[str, str] = {
     "exchange_resize": "a ring step's adaptive capacity exceeded the static "
                        "policy allocation — the per-step successor of the "
                        "whole-job capacity retry (step, cap, policy_cap)",
+    "clock_sync": "a process published one (wall, mono) clock pair so the "
+                  "journal merger (obs.merge) can align this journal's "
+                  "monotonic base with its peers' (process/source)",
+    "result_fetch": "a sorted result crossed device->host (n_keys) — the "
+                    "'fetched' stage boundary of the SLO histograms",
+    "flight_dump": "the fault flight recorder dumped a postmortem bundle "
+                   "(path, recovery_path)",
 }
 
 #: THE counter registry: every `Metrics.bump` name in the package, with its
@@ -108,6 +115,7 @@ COUNTERS: dict[str, str] = {
                               "(both schedules; whole mesh)",
     "exchange_bytes_saved": "wire bytes the ring schedule avoided vs the "
                             "policy-sized padded all_to_all",
+    "flight_dumps": "postmortem bundles dumped by the fault flight recorder",
 }
 
 
@@ -215,12 +223,19 @@ class EventLog:
 def to_chrome_trace(records: list[dict]) -> dict:
     """Records (``Event.to_dict`` shape) -> a Chrome ``trace_event`` object.
 
-    ``phase_start``/``phase_end`` pairs become B/E duration events (nested
-    per thread of emission is not tracked — phases pair by name, innermost
-    first); everything else becomes an instant event with its fields as
-    ``args``.  Timestamps are microseconds on the monotonic clock, rebased
-    to the first record, so the timeline lines up with a ``jax.profiler``
-    capture of the same run when loaded into Perfetto side by side.
+    ``phase_start``/``phase_end`` pairs become B/E duration events;
+    everything else becomes an instant event with its fields as ``args``.
+    Timestamps are microseconds on the monotonic clock, rebased to the
+    first record, so the timeline lines up with a ``jax.profiler`` capture
+    of the same run when loaded into Perfetto side by side.
+
+    Lane assignment: each source journal (the ``src`` field a merged
+    multi-host trace carries, `obs.merge`) renders as its own ``pid``, and
+    each job (the ``job`` ordinal `Metrics.event` stamps) as its own
+    ``tid`` within it — so CONCURRENT jobs' phase spans land on distinct
+    rows and can never pair B/E markers across jobs.  Records without a
+    job ordinal (bare `EventLog.emit` callers) keep the legacy single
+    lane.
     """
     if not records:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
@@ -229,6 +244,7 @@ def to_chrome_trace(records: list[dict]) -> dict:
     records = sorted(records, key=lambda r: (r["mono"], r.get("seq", 0)))
     t0 = records[0]["mono"]
     out = []
+    tids: dict[tuple, int] = {}  # (pid, job ordinal) -> tid, first-seen order
     for r in records:
         us = (r["mono"] - t0) * 1e6
         args = {
@@ -236,7 +252,23 @@ def to_chrome_trace(records: list[dict]) -> dict:
             for k, v in r.items()
             if k not in ("seq", "t", "mono", "type")
         }
-        common = {"pid": 1, "tid": 1, "ts": round(us, 1)}
+        pid = int(r.get("src", 0)) + 1
+        if "job" in r:
+            # Job lanes start at tid 2: tid 1 is reserved for records with
+            # no job ordinal (bare EventLog.emit callers, ingested native
+            # lines), so un-attributed events never share — or pair B/E
+            # markers with — a job's lane.
+            key = (pid, r["job"])
+            tid = tids.get(key)
+            if tid is None:
+                tid = tids[key] = sum(k[0] == pid for k in tids) + 2
+                out.append(
+                    {"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": f"job {r['job']}"}}
+                )
+        else:
+            tid = 1
+        common = {"pid": pid, "tid": tid, "ts": round(us, 1)}
         if r["type"] == "phase_start":
             out.append(
                 {"name": f"dsort:{args.get('phase', '?')}", "ph": "B",

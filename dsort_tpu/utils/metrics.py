@@ -10,9 +10,18 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import itertools
 import threading
 import time
 from collections import defaultdict
+
+#: Process-wide job ordinals: one `Metrics` instance == one logical job, so
+#: the first event a Metrics emits claims the next ordinal and every event
+#: of that job carries it as the ``job`` field.  This is what lets the
+#: Chrome-trace exporter give concurrent jobs distinct lanes and the SLO
+#: tracker attribute stage boundaries to the right job in an interleaved
+#: journal.  ``itertools.count`` is atomic under the GIL.
+_JOB_ORDINALS = itertools.count(1)
 
 
 @dataclasses.dataclass
@@ -36,8 +45,19 @@ class Metrics:
     journal: object | None = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    #: Live event taps (`dsort_tpu.obs`): objects with
+    #: ``observe(etype, fields, mono, metrics)``, called synchronously on
+    #: every `event` — the hook the telemetry registry and the fault flight
+    #: recorder ride WITHOUT needing a journal attached.  Taps must never
+    #: raise into the job (they are diagnostics).
+    taps: list = dataclasses.field(
+        default_factory=list, repr=False, compare=False
+    )
     _lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False
+    )
+    _job_ord: int | None = dataclasses.field(
+        default=None, repr=False, compare=False
     )
 
     def add(self, phase: str, seconds: float) -> None:
@@ -49,9 +69,31 @@ class Metrics:
             self.counters[counter] += by
 
     def event(self, etype: str, **fields) -> None:
-        """Emit a journal event; a no-op when no journal is attached."""
+        """Emit a journal event and fan it out to the live taps.
+
+        A no-op when neither a journal nor a tap is attached.  Every event
+        is stamped with this instance's ``job`` ordinal (`_JOB_ORDINALS`);
+        taps receive the journal's monotonic stamp so live consumers (the
+        SLO histograms) and post-hoc journal analysis derive IDENTICAL
+        durations.
+        """
+        if self.journal is None and not self.taps:
+            return
+        fields.setdefault("job", self._job_ordinal())
+        mono = None
         if self.journal is not None:
-            self.journal.emit(etype, **fields)
+            mono = self.journal.emit(etype, **fields).mono
+        if self.taps:
+            if mono is None:
+                mono = time.monotonic()
+            for tap in list(self.taps):
+                tap.observe(etype, dict(fields), mono, self)
+
+    def _job_ordinal(self) -> int:
+        with self._lock:
+            if self._job_ord is None:
+                self._job_ord = next(_JOB_ORDINALS)
+            return self._job_ord
 
     def total_s(self) -> float:
         return sum(self.phase_s.values())

@@ -90,10 +90,20 @@ def main() -> None:
         sizes = equal_partition(len(all_data), nprocs)
         start = int(np.sum(sizes[:pid]))
         data = all_data[start : start + sizes[pid]]
-        job = JobConfig(checkpoint_dir=os.environ["DSORT_MH_CKPT_DIR"])
+        job = JobConfig(
+            checkpoint_dir=os.environ["DSORT_MH_CKPT_DIR"],
+            # Telemetry-plane drill knobs: a flight-recorder dir so the
+            # crash-RESUME run dumps a postmortem bundle naming the
+            # multihost_partial path, and a tenant label on the journal.
+            flight_recorder_dir=os.environ.get("DSORT_MH_FLIGHT_DIR") or None,
+            tenant=os.environ.get("DSORT_MH_TENANT", "default"),
+        )
         journal = EventLog()
         m = Metrics(journal=journal)
         out, off = sort_local_shards(data, job=job, metrics=m, job_id="mhjob")
+        # Per-process journal JSONL: the parent's merged-trace assertions
+        # (obs.merge) read these back as a 2-journal fleet trace.
+        journal.write_jsonl(os.path.join(outdir, f"journal_{pid}.jsonl"))
         np.save(os.path.join(outdir, f"out_{pid}.npy"), out)
         with open(os.path.join(outdir, f"meta_{pid}.json"), "w") as f:
             # The event-type sequence rides along so the parent test can

@@ -1,0 +1,41 @@
+"""Fixture: obs-module discipline violations (DS201/DS202 + DS301).
+
+Models the telemetry plane's two riskiest shapes: a flight-recorder-like
+ring class whose state must stay lock-guarded with no blocking work under
+the lock (a dump writing to a full disk must never stall the emit path),
+and a scrape helper that must never run under trace (a jitted stage
+calling into telemetry would journal at compile time, once, forever).
+"""
+
+import threading
+import time
+
+import jax
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = []
+        self._seq = 0
+
+    def observe(self, ev):
+        with self._lock:
+            self._ring.append(ev)
+            self._seq += 1
+
+    def observe_racy(self, ev):
+        self._ring.append(ev)  # DS201: guarded attribute, no lock held
+
+    def dump(self, proc):
+        with self._lock:
+            time.sleep(0.01)  # DS202: blocking while holding the lock
+            proc.communicate()  # DS202
+
+
+@jax.jit
+def scrape_inside_trace(x, metrics):
+    metrics.event("job_start", n_keys=1)  # DS301: journals at trace time
+    t0 = time.monotonic()  # DS301: clock read baked in at trace time
+    print("scrape", t0)  # DS301
+    return x

@@ -1,0 +1,41 @@
+"""Fixture: near-miss clean twin of bad_obs — all discipline kept."""
+
+import threading
+import time
+
+import jax
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = []
+        self._seq = 0
+
+    def observe(self, ev):
+        with self._lock:
+            self._ring.append(ev)
+            self._seq += 1
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, proc):
+        with self._lock:  # snapshot under the lock ...
+            ring = list(self._ring)
+        time.sleep(0.0)  # ... blocking work AFTER it released: fine
+        proc.communicate()
+        return ring
+
+
+@jax.jit
+def pure_stage(x):
+    return x + 1
+
+
+def scrape_outside_trace(x, metrics):
+    y = pure_stage(x)  # device work traced, telemetry on the host side
+    metrics.event("job_done", n_keys=1)
+    t0 = time.monotonic()
+    return y, t0

@@ -1,0 +1,109 @@
+"""`bench.py --compare OLD NEW`: the bench-artifact regression differ.
+
+The in-tree BENCH_*.jsonl artifacts are a trajectory; this tool reads it.
+Covers the tolerance-ladder classification, added/removed coverage
+signals, error-line handling, and the CLI exit-code contract (severe
+fails; regression fails only under --strict — session noise must not turn
+CI red).
+"""
+
+import importlib.util
+import json
+import os
+
+_BENCH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
+)
+_spec = importlib.util.spec_from_file_location("dsort_bench_cmp", _BENCH)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _write(path, lines):
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps(bench._schema_header()) + "\n")
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+    return str(path)
+
+
+def _line(metric, value, unit="keys/sec", **extra):
+    return {"metric": metric, "value": value, "unit": unit, **extra}
+
+
+def test_ladder_classification():
+    assert bench.classify_ratio(1.2) == "ok"
+    assert bench.classify_ratio(0.95) == "ok"
+    assert bench.classify_ratio(0.90) == "noise"
+    assert bench.classify_ratio(0.60) == "regression"
+    assert bench.classify_ratio(0.10) == "severe"
+
+
+def test_compare_rows(tmp_path):
+    old = _write(tmp_path / "old.jsonl", [
+        _line("a", 100.0),
+        _line("b", 100.0),
+        _line("c", 100.0),
+        _line("gone", 5.0),
+        _line("ratio_line", 1.1, unit="ratio"),
+        _line("errored", 0.0, error="boom"),
+        {"metric": "summary", "value": 1, "unit": "keys/sec", "lines": {}},
+    ])
+    new = _write(tmp_path / "new.jsonl", [
+        _line("a", 99.0),       # ok
+        _line("b", 82.0),       # noise
+        _line("c", 30.0),       # severe
+        _line("fresh", 1.0),    # added
+        _line("ratio_line", 1.2, unit="ratio"),  # info (not a rate)
+        _line("errored", 50.0),                  # error side -> class error
+        {"metric": "summary", "value": 1, "unit": "keys/sec", "lines": {}},
+    ])
+    rows = {r["metric"]: r for r in bench.compare_artifacts(old, new)}
+    assert "summary" not in rows  # summary/header lines never diff
+    assert rows["a"]["class"] == "ok" and rows["a"]["ratio"] == 0.99
+    assert rows["b"]["class"] == "noise"
+    assert rows["c"]["class"] == "severe" and rows["c"]["ratio"] == 0.3
+    assert rows["gone"]["class"] == "removed"
+    assert rows["fresh"]["class"] == "added"
+    assert rows["ratio_line"]["class"] == "info"
+    assert rows["errored"]["class"] == "error"
+
+
+def test_compare_cli_exit_codes(tmp_path, capsys):
+    old = _write(tmp_path / "o.jsonl", [_line("a", 100.0), _line("b", 100.0)])
+    ok_new = _write(tmp_path / "n1.jsonl", [_line("a", 96.0), _line("b", 101.0)])
+    assert bench._compare_main([old, ok_new]) == 0
+    reg_new = _write(tmp_path / "n2.jsonl", [_line("a", 60.0), _line("b", 101.0)])
+    # regression: reported, not fatal — unless --strict
+    assert bench._compare_main([old, reg_new]) == 0
+    assert bench._compare_main([old, reg_new, "--strict"]) == 1
+    sev_new = _write(tmp_path / "n3.jsonl", [_line("a", 10.0), _line("b", 101.0)])
+    assert bench._compare_main([old, sev_new]) == 1
+    out = capsys.readouterr().out
+    # the summary line closes each run with the ladder + class counts
+    summaries = [
+        json.loads(ln) for ln in out.splitlines()
+        if '"compare_summary"' in ln
+    ]
+    assert summaries and summaries[-1]["classes"].get("severe") == 1
+
+
+def test_compare_cli_usage_errors(tmp_path):
+    assert bench._compare_main([]) == 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert bench._compare_main([str(empty), str(empty)]) == 2
+
+
+def test_in_tree_trajectory_compares(tmp_path):
+    """The recorded artifacts really feed the differ: comparing the in-tree
+    trajectory yields rows (classes are machine-dependent; the tool must
+    parse them, not judge them here)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    old = os.path.join(repo, "BENCH_r05_preview.jsonl")
+    new = os.path.join(repo, "BENCH_r06.jsonl")
+    rows = bench.compare_artifacts(old, new)
+    assert rows, "in-tree artifacts must produce comparison rows"
+    assert any("ratio" in r for r in rows) or any(
+        r["class"] in ("added", "removed") for r in rows
+    )

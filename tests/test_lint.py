@@ -70,6 +70,16 @@ def test_tracing_checker_fixture():
     assert run_fixture("good_tracing.py") == []
 
 
+def test_obs_fixture():
+    """The telemetry plane's discipline contract: recorder-ring state stays
+    lock-guarded with no blocking work under the lock, and nothing scrapes
+    or journals from inside a traced function."""
+    diags = run_fixture("bad_obs.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS201": 1, "DS202": 2, "DS301": 3}
+    assert run_fixture("good_obs.py") == []
+
+
 def test_exceptions_checker_fixture():
     # Fixtures live outside the checker's recovery-path scope: rescope.
     scoped = [ExceptionsChecker(scope=("*.py",))]

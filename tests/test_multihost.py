@@ -329,6 +329,76 @@ def test_multihost_checkpoint_crash_resume(tmp_path):
         assert "multihost_resort_keys" not in meta["counters"]
 
 
+def test_multihost_crash_drill_merged_trace_and_postmortem(tmp_path):
+    """PR 6 acceptance: a 2-process crash drill produces ONE merged trace
+    with monotonic aligned timestamps (obs.merge over the per-process
+    journals) plus a postmortem bundle naming the resume path — the
+    multi-host crash-retry — and its cost (resort_keys)."""
+    from dsort_tpu.obs import FlightRecorder, merge_journals, slo_from_journal
+
+    ck = tmp_path / "ck"
+    flights = tmp_path / "flights"
+    expect = np.sort(_mh_global_data())
+    env = {
+        "DSORT_MH_CKPT_DIR": str(ck),
+        "DSORT_MH_FLIGHT_DIR": str(flights),
+        "DSORT_MH_TENANT": "acme",
+    }
+
+    # Run 1: the crash — process 1 dies between the collective and its
+    # range persist (same drill state as the canonical crash_resume test).
+    r1 = tmp_path / "run1"
+    r1.mkdir()
+    _run_cluster(
+        r1, "ckpt", nprocs=2,
+        env_extra={**env, "DSORT_MH_DIE_BEFORE_RANGE": "1"},
+        expect_rc={0: "any", 1: 17},
+        require_files=[ck / "mhjob" / "range_00000.npy"],
+    )
+
+    # Run 2: the crash-RETRY — both processes resume, restore range 0 and
+    # re-sort only the missing interval.
+    r2 = tmp_path / "run2"
+    r2.mkdir()
+    _run_cluster(r2, "ckpt", nprocs=2, env_extra=env)
+    got, metas = _ckpt_outputs(r2, 2)
+    np.testing.assert_array_equal(got, expect)
+
+    # ONE merged fleet trace from the two per-process journals: records
+    # from BOTH processes, monotonically aligned, globally re-sequenced,
+    # with the clock_sync handshake pairs present per source.
+    journals = [str(r2 / f"journal_{i}.jsonl") for i in range(2)]
+    merged, skipped = merge_journals(journals)
+    assert skipped == 0
+    assert {r["src"] for r in merged} == {0, 1}
+    monos = [r["mono"] for r in merged]
+    assert monos == sorted(monos)
+    assert [r["seq"] for r in merged] == list(range(len(merged)))
+    for src in (0, 1):
+        src_types = [r["type"] for r in merged if r["src"] == src]
+        assert "clock_sync" in src_types
+        assert src_types[0] in ("job_start", "clock_sync")
+        assert "checkpoint_restore" in src_types and "job_done" in src_types
+    # the merged trace carries the per-tenant SLO signal end to end
+    truth = slo_from_journal(merged)
+    assert ("acme", "admit_to_sorted") in truth
+    assert truth[("acme", "admit_to_sorted")].count == 2  # one per process
+
+    # The postmortem bundle names the resume path and its cost.
+    bundles = FlightRecorder.read_bundles(str(flights))
+    partial = [
+        b for b in bundles
+        if b["recovery_path"] == "checkpoint_restore:multihost_partial"
+    ]
+    assert partial, f"no multihost_partial bundle in {[b['recovery_path'] for b in bundles]}"
+    b = partial[0]
+    assert b["detail"]["n"] == 1  # one surviving range restored
+    assert 0 < b["detail"]["resort_keys"] < len(expect)  # the re-run cost
+    assert b["state"]["mode"] == "multihost"
+    assert b["config"]["tenant"] == "acme"
+    assert any(r["type"] == "job_start" for r in b["ring"])
+
+
 @pytest.mark.slow
 def test_multihost_checkpoint_stale_data_clears(tmp_path):
     """A job_id resumed against DIFFERENT global data must not serve stale
