@@ -90,6 +90,15 @@ BENCH_SCHEMA_FIELD_TYPES = {
     "exchange": "str",
     "error": "str",
     "skipped": "str",
+    # Serving-layer mixed-workload row (`dsort bench --serve-mixed`):
+    "p95_queue_wait_ms": "num",
+    "fairness_p95_ratio": "num",
+    "cache_hit_rate": "num",
+    "speedup_vs_serial": "num",
+    "jobs": "num",
+    "tenants": "num",
+    "prewarmed": "num",
+    "slices": "num",
 }
 
 _SCHEMA_TYPE_CHECKS = {
@@ -1014,6 +1023,47 @@ print(json.dumps({
     except Exception as e:  # the ladder must never sink the artifact
         _emit(
             "exchange_ring_vs_alltoall_8dev_cpu_mesh", 0.0, "keys/sec",
+            baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
+
+    # Multi-tenant serving-layer row (ISSUE 7): a mixed small/large
+    # three-tenant workload through the real admission queue with
+    # mesh-slice packing, on the 8-device cpu mesh.  The harness is
+    # `dsort bench --serve-mixed` — ONE copy of the acceptance contract,
+    # shared with `make serve-smoke` — re-emitted here with the cpu-mesh
+    # suffix: jobs/s over the mixed workload, p95 queue wait and the
+    # per-tenant fairness ratio from the journal's job_dequeued records,
+    # the compiled-variant cache hit rate on the repeat-size jobs, and the
+    # packed-vs-serial small-job speedup.
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "dsort_tpu.cli", "bench",
+                "--serve-mixed", "--n", str(400_000), "--reps", "1",
+            ],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        rows = []
+        for ln in r.stdout.strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        for row in rows:
+            row["metric"] += "_8dev_cpu_mesh"
+            _emit_line(row)
+        if not rows:
+            raise RuntimeError(
+                f"serve-mixed emitted no rows (rc {r.returncode}): "
+                + (r.stderr.strip().splitlines() or ["no stderr"])[-1][:160]
+            )
+    except Exception as e:  # the ladder must never sink the artifact
+        _emit(
+            "service_mixed_workload_8dev_cpu_mesh", 0.0, "jobs/sec",
             baseline=False,
             error=(str(e).splitlines() or [repr(e)])[0][:200],
         )

@@ -440,16 +440,76 @@ def cmd_run(args) -> int:
     return 0
 
 
+def _sigterm_to_interrupt(signum, frame):
+    """SIGTERM handler for ``dsort serve``: route the signal into the SAME
+    graceful path as Ctrl-C (drain in-flight jobs, reject new admissions,
+    flush the journal, exit 0) instead of dying mid-job with an open
+    journal."""
+    raise KeyboardInterrupt
+
+
+def _make_serve_service(args, cfg, journal, telemetry):
+    """The `serve.SortService` behind ``dsort serve`` (every mode).
+
+    spmd mode gets the full serving core — mesh-slice packing for small
+    jobs, the SPMD scheduler for big ones, the compiled-variant cache;
+    local/taskpool modes wrap their one-job sorter as the service runner,
+    keeping admission, fairness, and graceful shutdown semantics uniform.
+    """
+    import dataclasses
+
+    from dsort_tpu.serve import SortService
+    from dsort_tpu.serve.fair import parse_weights
+
+    serve_over: dict = {}
+    if getattr(args, "slice_devices", None):
+        serve_over["slice_devices"] = args.slice_devices
+    if getattr(args, "queue_limit", None):
+        serve_over["max_queue_depth"] = args.queue_limit
+    if getattr(args, "tenant_limit", None):
+        serve_over["max_tenant_inflight"] = args.tenant_limit
+    if getattr(args, "weights", None):
+        serve_over["tenant_weights"] = parse_weights(args.weights)
+    serve_cfg = dataclasses.replace(cfg.serve, **serve_over)
+    kwargs = dict(
+        job=cfg.job, serve=serve_cfg, telemetry=telemetry, journal=journal,
+        journal_path=getattr(args, "journal", None),
+    )
+    if args.mode == "spmd":
+        import jax
+
+        devs = jax.devices()
+        n = cfg.mesh.num_workers or len(devs)
+        service = SortService(devices=devs[:n], **kwargs)
+    else:
+        service = SortService(runner=_make_sorter(cfg, args.mode), **kwargs)
+    if getattr(args, "prewarm", False) or serve_cfg.prewarm:
+        n = service.prewarm()
+        log.info("compiled-variant cache prewarmed: %d rung(s)", n)
+    return service
+
+
 def cmd_serve(args) -> int:
-    """The reference's interactive job loop (server.c:160-167 workflow).
+    """The reference's interactive job loop (server.c:160-167 workflow),
+    served by the multi-tenant async core (`dsort_tpu.serve`).
+
+    Each input line submits a job through admission control; with
+    ``--max-in-flight 1`` (the default) the REPL awaits each result —
+    byte-compatible with the old blocking loop — while ``--max-in-flight
+    N`` lets N jobs run concurrently (small jobs packed onto mesh
+    sub-slices, big jobs on the full mesh).  A line may name its tenant:
+    ``tenant=acme data.txt``.  SIGINT/SIGTERM drain in-flight jobs, reject
+    new admissions with a typed verdict, flush the journal, and exit 0.
 
     ``--metrics-port`` additionally exposes the live telemetry endpoint
     (`obs.MetricsServer`): Prometheus text at ``/metrics`` (counters,
-    phase timings, queue depth, per-tenant SLO quantiles), JSON at
-    ``/json``; render a scrape with ``dsort top``.
+    queue depth, per-tenant admission verdicts and SLO quantiles,
+    compiled-variant cache stats), JSON at ``/json``; render a scrape
+    with ``dsort top``.
     """
+    import signal
+
     cfg = _load_config(args)
-    sorter = _make_sorter(cfg, args.mode)
     dtype = np.dtype(cfg.job.key_dtype)
     journal = _open_journal(args)
     if args.job_id and cfg.job.checkpoint_dir:
@@ -465,47 +525,119 @@ def cmd_serve(args) -> int:
         from dsort_tpu.obs import MetricsServer, Telemetry
 
         telemetry = Telemetry()
-        # The REPL admits one job at a time — depth 0 until the async
-        # admission queue (ROADMAP item 1) drives this gauge for real.
-        telemetry.set_gauge("queue_depth", 0)
         server = MetricsServer(telemetry, port=args.metrics_port)
         log.info("metrics endpoint: %s (render with `dsort top %s`)",
                  server.url, server.url)
+    service = _make_serve_service(args, cfg, journal, telemetry)
+    old_term = None
     try:
-        return _serve_loop(args, cfg, sorter, dtype, journal, telemetry)
+        old_term = signal.signal(signal.SIGTERM, _sigterm_to_interrupt)
+    except ValueError:
+        pass  # not the main thread (tests): Ctrl-C path still covered
+    try:
+        return _serve_loop(args, cfg, service, dtype, journal)
     finally:
+        if old_term is not None:
+            signal.signal(signal.SIGTERM, old_term)
         if server is not None:
             server.close()
 
 
-def _serve_loop(args, cfg, sorter, dtype, journal, telemetry) -> int:
+def _parse_serve_line(line: str, default_tenant: str) -> tuple[str, str]:
+    """``[tenant=NAME] path`` -> (tenant, path)."""
+    name = line.strip()
+    tenant = default_tenant
+    if name.startswith("tenant="):
+        head, _, rest = name.partition(" ")
+        tenant = head[len("tenant="):]
+        name = rest.strip()
+    return tenant, name
+
+
+def _serve_loop(args, cfg, service, dtype, journal) -> int:
+    from dsort_tpu.data.ingest import read_ints_file, write_ints_file
+
+    out_path = args.output or cfg.output_path
+    max_in_flight = max(getattr(args, "max_in_flight", 1) or 1, 1)
+    pending: list[tuple[str, float, object]] = []  # (name, t0, ticket)
+
+    def reap(limit: int) -> None:
+        """Write out finished tickets (FIFO); block on the OLDEST only
+        while ``limit`` or more jobs are in flight — the window refills
+        one slot at a time instead of draining in batch waves."""
+        while pending:
+            name, t0, ticket = pending[0]
+            if len(pending) < limit and not ticket.done():
+                break
+            pending.pop(0)
+            try:
+                out = ticket.result()
+            except Exception as e:  # a bad job must not kill the server
+                log.error("job failed (%s): %s", name, e)
+                continue
+            try:
+                write_ints_file(out_path, out)
+            except OSError as e:  # nor an unwritable output path
+                log.error("result write failed (%s): %s", name, e)
+                continue
+            log.info(
+                "sorted %d keys in %.1f ms (%s, tenant %s) -> %s | %s",
+                len(out), (time.perf_counter() - t0) * 1e3, name,
+                ticket.tenant, out_path, dict(ticket.metrics.counters),
+            )
+
+    interrupted = False
     while True:
         try:
             line = input("Enter the filename to sort (or 'exit' to quit): ")
         except EOFError:
-            return 0
+            break
         except KeyboardInterrupt:
-            # Clean Ctrl-C exit, like the reference's SIGINT handler closing
-            # its sockets (server.c:51-59,106) — no traceback spray.
+            # The graceful-shutdown path (SIGINT, and SIGTERM via
+            # `_sigterm_to_interrupt`): no traceback spray — drain below.
             print()
-            return 0
-        name = line.strip()
+            interrupted = True
+            break
+        tenant, name = _parse_serve_line(line, cfg.job.tenant)
         if not name:
             continue
         if name == "exit":
-            return 0
+            break
         try:
-            jid = (
-                _job_id_for(name, None) if cfg.job.checkpoint_dir else None
+            data = read_ints_file(name, dtype=dtype)
+        except Exception as e:  # unreadable input must not kill the server
+            log.error("job failed (%s): %s", name, e)
+            continue
+        jid = _job_id_for(name, None) if cfg.job.checkpoint_dir else None
+        verdict, ticket = service.submit(
+            data, tenant=tenant, job_id=name, ckpt_job_id=jid
+        )
+        if not verdict.admitted:
+            log.error(
+                "job NOT admitted (%s): %s (queue depth %d, tenant depth %d)",
+                name, verdict.reason, verdict.queue_depth,
+                verdict.tenant_depth,
             )
-            _run_one(sorter, name, args.output or cfg.output_path, dtype,
-                     job_id=jid, journal=journal, telemetry=telemetry)
-        except Exception as e:  # a bad job must not kill the server
-            log.error("job failed: %s", e)
-        finally:
-            # One cumulative journal across REPL jobs, rewritten after each
-            # so a later crash never loses earlier jobs' timelines.
-            _write_journal(journal, args)
+            continue
+        pending.append((name, time.perf_counter(), ticket))
+        # Sync mode (default) awaits every job — the reference's blocking
+        # REPL semantics; async mode keeps up to max_in_flight jobs
+        # running and frees one slot before prompting again.  Journal
+        # flushing is the SERVICE's job (one writer): it appends after
+        # every completion.
+        reap(limit=max_in_flight)
+    if interrupted:
+        st = service.stats()
+        log.warning(
+            "shutting down: draining %d queued + %d in-flight job(s); new "
+            "admissions are rejected with verdict 'shutting_down'",
+            st["queued"], st["in_flight"],
+        )
+    service.shutdown(drain=True)
+    reap(limit=1)  # drain: every remaining ticket is done or failed
+    # The journal's close: the service recorded serve_stop and flushed the
+    # file during shutdown; a journal-less session has nothing to write.
+    return 0
 
 
 _REF_KEYS_PER_SEC = 16_384 / 0.374  # BASELINE.md measured reference throughput
@@ -861,11 +993,157 @@ def _bench_exchange_ab(args, cfg: SortConfig) -> int:
     return 0 if ok_all else 1
 
 
+def _bench_serve_mixed(args, cfg: SortConfig) -> int:
+    """`dsort bench --serve-mixed`: the multi-tenant serving benchmark.
+
+    The `make serve-smoke` target and THE acceptance harness for the
+    serving layer (ARCHITECTURE §8): a mixed workload — 4 small jobs from
+    each of 3 tenants (two repeat sizes, so the compiled-variant cache can
+    prove reuse) plus one large job — submitted concurrently through the
+    real admission queue onto the packed mesh.  Asserts every output
+    bit-identical to ``np.sort`` of its input, then emits ONE JSON line:
+    jobs/s over the mixed workload, p95 queue wait from the journal's
+    ``job_dequeued`` records, per-tenant p95 fairness ratio, compiled-
+    variant cache hit rate, and the packed-vs-serial small-job speedup
+    (the same jobs through a single-slice service).
+    """
+    import dataclasses
+
+    import jax
+
+    from dsort_tpu.data.ingest import gen_uniform
+    from dsort_tpu.obs import Telemetry
+    from dsort_tpu.serve import SortService
+    from dsort_tpu.utils.events import EventLog
+
+    devs = jax.devices()
+    n_devs = cfg.mesh.num_workers or len(devs)
+    if n_devs < 2:
+        raise SystemExit(
+            "--serve-mixed needs a multi-device mesh (packing small jobs "
+            "onto sub-slices of one device is serial dispatch by another "
+            "name); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    devs = devs[:n_devs]
+    n_small = max(min(args.n, 1 << 19), 1 << 10)
+    n_large = 1 << 20  # >= FUSED_SMALL_JOB_MAX: routes to the full mesh
+    tenants = ("acme", "blue", "coral")
+    rng = np.random.default_rng(0)
+    # Two repeat sizes per tenant: repeat-size jobs are where the variant
+    # cache must show ≥ 50% hits.
+    small_jobs = []
+    for j in range(4):
+        for t in tenants:
+            n = n_small if j % 2 == 0 else max(n_small // 2, 1 << 9)
+            small_jobs.append(
+                (t, rng.integers(0, 1 << 30, n).astype(np.int32))
+            )
+    large = rng.integers(0, 1 << 30, n_large).astype(np.int32)
+    serve_cfg = dataclasses.replace(
+        cfg.serve,
+        max_queue_depth=max(cfg.serve.max_queue_depth, len(small_jobs) + 4),
+        max_tenant_inflight=max(
+            cfg.serve.max_tenant_inflight, len(small_jobs) + 2
+        ),
+    )
+    journal = _open_journal(args) or EventLog()
+    tel = Telemetry()
+
+    def run_window(svc, jobs, with_large: bool) -> tuple[float, bool]:
+        t0 = time.perf_counter()
+        tickets = [svc.submit(d, tenant=t)[1] for t, d in jobs]
+        if with_large:
+            tickets.append(svc.submit(large, tenant="acme")[1])
+        ok = True
+        for (t, d), ticket in zip(jobs + ([("acme", large)] if with_large else []), tickets):
+            out = ticket.result(timeout=600)
+            ok = ok and bool(np.array_equal(out, np.sort(d)))
+        return time.perf_counter() - t0, ok
+
+    # Serial baseline: the same small jobs through a ONE-slice service
+    # (slice_devices = mesh size), prewarmed like the packed one — the
+    # delta is pure packing, not compiles.
+    sizes = sorted({len(d) for _, d in small_jobs})
+    serial = SortService(
+        devices=devs,
+        job=cfg.job,
+        serve=dataclasses.replace(serve_cfg, slice_devices=n_devs),
+    )
+    serial.prewarm(sizes=sizes)
+    dt_serial, ok_serial = run_window(serial, small_jobs, with_large=False)
+    serial.shutdown()
+
+    svc = SortService(
+        devices=devs, job=cfg.job, serve=serve_cfg, telemetry=tel,
+        journal=journal,
+    )
+    prewarmed = svc.prewarm(sizes=sizes)
+    svc._sched.sort(large)  # warm the full-mesh SPMD program once
+    dt_packed, ok_packed = run_window(svc, small_jobs, with_large=False)
+    mixed_start = len(journal)
+    dt_mixed, ok_mixed = run_window(svc, small_jobs, with_large=True)
+    stats = svc.stats()
+    hit_rate = svc.variants.hit_rate()
+    svc.shutdown()
+    try:
+        if getattr(args, "journal", None):
+            journal.flush_jsonl(args.journal)
+    except OSError as e:
+        log.warning("serve-mixed journal write failed: %s", e)
+    waits: dict[str, list[float]] = {}
+    all_waits: list[float] = []
+    for e in journal.events()[mixed_start:]:
+        if e.type == "job_dequeued":
+            w = float(e.fields.get("wait_s", 0.0))
+            all_waits.append(w)
+            # The fairness ratio compares LIKE costs: the large job's long
+            # wait is its deficit-round-robin cost paying off (it must
+            # accumulate the whole mesh), not a tenant being starved.
+            if not e.fields.get("big"):
+                waits.setdefault(e.fields.get("tenant", "?"), []).append(w)
+    p95 = float(np.percentile(all_waits, 95)) if all_waits else 0.0
+    tenant_p95 = {
+        t: float(np.percentile(ws, 95))
+        for t, ws in waits.items() if t in tenants and ws
+    }
+    fairness = (
+        max(tenant_p95.values()) / max(min(tenant_p95.values()), 1e-9)
+        if len(tenant_p95) > 1 else 1.0
+    )
+    ok = ok_serial and ok_packed and ok_mixed
+    jobs_total = len(small_jobs) + 1
+    print(json.dumps({
+        "metric": "service_mixed_workload",
+        "value": round(jobs_total / dt_mixed, 2),
+        "unit": "jobs/sec",
+        "jobs": jobs_total,
+        "tenants": len(tenants),
+        "p95_queue_wait_ms": round(p95 * 1e3, 2),
+        "fairness_p95_ratio": round(fairness, 2),
+        "cache_hit_rate": round(hit_rate, 3),
+        "prewarmed": prewarmed,
+        "speedup_vs_serial": round(dt_serial / dt_packed, 2),
+        "bit_identical": ok,
+        "slices": stats["slices"],
+    }), flush=True)
+    return 0 if ok else 1
+
+
 def cmd_bench(args) -> int:
     from dsort_tpu.data.ingest import gen_uniform
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if getattr(args, "serve_mixed", False):
+        if args.suite or getattr(args, "device_resident", False) or getattr(
+            args, "exchange_ab", False
+        ):
+            raise SystemExit(
+                "--serve-mixed is its own benchmark: run it as a separate "
+                "invocation"
+            )
+        return _bench_serve_mixed(args, _load_config(args))
     if getattr(args, "exchange_ab", False):
         if args.suite or getattr(args, "device_resident", False):
             raise SystemExit(
@@ -1368,12 +1646,32 @@ def main(argv=None) -> int:
     common(p)
     p.set_defaults(fn=cmd_run)
 
-    p = sub.add_parser("serve", help="interactive job loop (reference REPL)")
+    p = sub.add_parser("serve", help="interactive job loop (reference REPL, "
+                                     "served by the async multi-tenant core)")
     common(p)
     p.add_argument("--metrics-port", type=int,
                    help="expose the live telemetry endpoint on this port "
                         "(0 = ephemeral; Prometheus text at /metrics, "
                         "JSON at /json; view with `dsort top`)")
+    p.add_argument("--max-in-flight", type=int, default=1,
+                   help="REPL jobs in flight at once (default 1 = await "
+                        "each job, the reference's blocking semantics; >1 "
+                        "= async submit with concurrent mesh-slice packing)")
+    p.add_argument("--prewarm", action="store_true",
+                   help="compile the capacity ladder's fused rungs at "
+                        "startup (the compiled-variant cache serves the "
+                        "first job of every size warm)")
+    p.add_argument("--slice-devices", type=int,
+                   help="devices per small-job mesh sub-slice (default 1; "
+                        "concurrent small jobs pack onto disjoint slices)")
+    p.add_argument("--queue-limit", type=int,
+                   help="admission control: max jobs queued service-wide")
+    p.add_argument("--tenant-limit", type=int,
+                   help="admission control: max queued+running jobs per "
+                        "tenant")
+    p.add_argument("--weights",
+                   help="fair-scheduler tenant weights, e.g. acme=2,blue=1 "
+                        "(unlisted tenants weigh 1)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("bench", help="throughput benchmark (one JSON line)")
@@ -1389,6 +1687,12 @@ def main(argv=None) -> int:
                    help="ring-vs-alltoall exchange A/B on the local mesh "
                         "(uniform + zipf; asserts bit-identical outputs, "
                         "reports bytes_on_wire per schedule)")
+    p.add_argument("--serve-mixed", action="store_true",
+                   help="multi-tenant serving benchmark: a mixed small/large "
+                        "three-tenant workload through the real admission "
+                        "queue with mesh-slice packing; one JSON line with "
+                        "jobs/s, p95 queue wait, fairness ratio, variant-"
+                        "cache hit rate and packed-vs-serial speedup")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser(

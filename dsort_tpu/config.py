@@ -202,11 +202,80 @@ class JobConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Multi-tenant serving-layer knobs (`dsort_tpu.serve.SortService`).
+
+    Bounds and policies of the async admission queue, the weighted
+    deficit-round-robin fair scheduler, mesh-slice packing, and the
+    compiled-variant cache (ARCHITECTURE §8).  The prewarm range is
+    expressed in key counts and expands to the same 8-aligned 1/8-power-
+    of-two capacity ladder (`parallel.exchange.ladder_rungs` /
+    `models.pipelines.pad_rung`) the compiled variants are keyed on.
+    """
+
+    max_queue_depth: int = 64       # jobs queued service-wide (admission bound)
+    max_tenant_inflight: int = 16   # one tenant's queued + running jobs
+    slice_devices: int = 1          # devices per small-job mesh sub-slice
+    small_job_max: int | None = None  # None -> models.pipelines.FUSED_SMALL_JOB_MAX
+    # Fair-scheduler deficit granted per visit, in keys.  Deliberately
+    # SMALL relative to typical jobs: a tenant dispatches at most
+    # ~quantum/job_cost jobs per rotation, so tenants interleave at fine
+    # grain; a job costlier than the quantum simply accumulates deficit
+    # over several (cheap, host-side) rotations while others are served.
+    drr_quantum_keys: int = 1 << 14
+    tenant_weights: Mapping[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+    variant_cache_entries: int = 64  # LRU bound on cached compiled variants
+    prewarm: bool = False            # compile the ladder's rungs at startup
+    prewarm_min_keys: int = 1 << 14
+    prewarm_max_keys: int = 1 << 16
+
+    def __post_init__(self) -> None:
+        if self.max_queue_depth < 1:
+            raise ConfigError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}"
+            )
+        if self.max_tenant_inflight < 1:
+            raise ConfigError(
+                f"max_tenant_inflight must be >= 1, got {self.max_tenant_inflight}"
+            )
+        if self.slice_devices < 1:
+            raise ConfigError(
+                f"slice_devices must be >= 1, got {self.slice_devices}"
+            )
+        if self.small_job_max is not None and self.small_job_max < 1:
+            raise ConfigError(
+                f"small_job_max must be >= 1, got {self.small_job_max}"
+            )
+        if self.drr_quantum_keys < 1:
+            raise ConfigError(
+                f"drr_quantum_keys must be >= 1, got {self.drr_quantum_keys}"
+            )
+        if self.variant_cache_entries < 1:
+            raise ConfigError(
+                "variant_cache_entries must be >= 1, got "
+                f"{self.variant_cache_entries}"
+            )
+        for t, w in dict(self.tenant_weights).items():
+            if not (isinstance(w, (int, float)) and w > 0):
+                raise ConfigError(
+                    f"tenant weight for {t!r} must be > 0, got {w!r}"
+                )
+        if not (0 < self.prewarm_min_keys <= self.prewarm_max_keys):
+            raise ConfigError(
+                "prewarm range must satisfy 0 < min <= max, got "
+                f"[{self.prewarm_min_keys}, {self.prewarm_max_keys}]"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class SortConfig:
     """Top-level framework config: mesh + job + control-plane endpoints."""
 
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     job: JobConfig = dataclasses.field(default_factory=JobConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     # Control-plane endpoint (native coordinator; reference server.conf parity).
     server_ip: str = "127.0.0.1"
     server_port: int = 9008        # reference default, server.conf:1
@@ -220,7 +289,10 @@ class SortConfig:
         plus framework keys (``NUM_WORKERS``, ``KEY_DTYPE``, ``OVERSAMPLE``,
         ``CAPACITY_FACTOR``, ``PAYLOAD_BYTES``, ``HEARTBEAT_TIMEOUT_S``,
         ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``, ``EXCHANGE``,
-        ``TENANT``, ``FLIGHT_DIR``).
+        ``TENANT``, ``FLIGHT_DIR``) and serving-layer keys
+        (``SERVE_QUEUE_DEPTH``, ``SERVE_TENANT_INFLIGHT``,
+        ``SERVE_SLICE_DEVICES``, ``SERVE_SMALL_JOB_MAX``,
+        ``SERVE_WEIGHTS`` — ``tenant=weight,...`` — and ``SERVE_PREWARM``).
         """
         def geti(key: str, default: int | None) -> int | None:
             return int(m[key]) if key in m else default
@@ -248,9 +320,23 @@ class SortConfig:
             tenant=m.get("TENANT", JobConfig.tenant),
             flight_recorder_dir=m.get("FLIGHT_DIR") or None,
         )
+        from dsort_tpu.serve.fair import parse_weights
+
+        serve = ServeConfig(
+            max_queue_depth=geti("SERVE_QUEUE_DEPTH", ServeConfig.max_queue_depth),
+            max_tenant_inflight=geti(
+                "SERVE_TENANT_INFLIGHT", ServeConfig.max_tenant_inflight
+            ),
+            slice_devices=geti("SERVE_SLICE_DEVICES", ServeConfig.slice_devices),
+            small_job_max=geti("SERVE_SMALL_JOB_MAX", None),
+            tenant_weights=parse_weights(m.get("SERVE_WEIGHTS")),
+            prewarm=m.get("SERVE_PREWARM", "0").strip().lower()
+            in ("1", "true", "yes"),
+        )
         return cls(
             mesh=mesh,
             job=job,
+            serve=serve,
             server_ip=m.get("SERVER_IP", "127.0.0.1"),
             server_port=int(m.get("SERVER_PORT", 9008)),
             output_path=m.get("OUTPUT_PATH", "output.txt"),

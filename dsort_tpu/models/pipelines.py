@@ -47,6 +47,35 @@ local_pipeline_step = jax.jit(local_pipeline)
 FUSED_SMALL_JOB_MAX = 1 << 20
 
 
+def pad_rung(n: int) -> int:
+    """The fused path's capacity-ladder rung for an ``n``-key job.
+
+    Pads to 1/8-of-a-power-of-two granularity, not a full power of two:
+    <= 12.5% padded work at any size while bounding distinct compiled
+    programs to 8 per size decade — the same 8-aligned rung quantization
+    the exchange buffers use (`parallel.exchange.ring_step_quantum`).
+    This is THE key the compiled-variant cache (`serve.variants`) stores
+    fused programs under; `parallel.exchange.ladder_rungs` enumerates the
+    ladder for prewarming.
+    """
+    step = max(8, 1 << max((n - 1).bit_length() - 3, 0))
+    return -(-n // step) * step
+
+
+def pad_for_fused(data: np.ndarray) -> np.ndarray:
+    """THE rung-padded host staging buffer for `_fused_small_fn`.
+
+    One copy of the padding contract (shared with the serving layer's
+    slice dispatch): the tail beyond ``len(data)`` is uninitialized
+    garbage, masked to the dtype sentinel ON DEVICE by `sort_padded`, so
+    trimming the sorted result to the input length is exact even for
+    sentinel-valued real keys.
+    """
+    buf = np.empty(pad_rung(len(data)), data.dtype)
+    buf[: len(data)] = data
+    return buf
+
+
 @functools.lru_cache(maxsize=64)
 def _fused_small_fn(n_pad: int, dtype_str: str, kernel: str):
     del dtype_str  # part of the cache key; the jit re-specializes by dtype
@@ -107,15 +136,12 @@ def fused_sort_small(
             metrics.event("device_handle", n_keys=0, shards=1)
             return h
         return data.copy()
-    # Pad to 1/8-of-a-power-of-two granularity, not a full power of two:
-    # <= 12.5% padded work at any size (a big job padded to the next pow2
-    # would pay up to 2x) while still bounding distinct compiled programs
-    # to 8 per size decade.
-    step = max(8, 1 << max((n - 1).bit_length() - 3, 0))
-    n_pad = -(-n // step) * step
+    # Pad to the capacity-ladder rung (`pad_rung`): <= 12.5% padded work at
+    # any size (a big job padded to the next pow2 would pay up to 2x) while
+    # still bounding distinct compiled programs to 8 per size decade.
     with timer.phase("partition"):
-        buf = np.empty(n_pad, data.dtype)
-        buf[:n] = data  # tail garbage is sentinel-masked on device
+        buf = pad_for_fused(data)
+    n_pad = len(buf)
     if keep_on_device:
         from dsort_tpu.parallel.device_result import DeviceSortResult
 

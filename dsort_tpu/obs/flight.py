@@ -51,6 +51,7 @@ RECOVERY_EVENTS = frozenset(
         "checkpoint_restore",        # resume instead of re-sort (incl. multihost)
         "fused_fallback",            # fused path failed over to the scheduler
         "transient_retry",           # in-place retry on a healthy mesh
+        "job_evicted",               # serving layer evicted a job off a slice
     }
 )
 
@@ -113,11 +114,16 @@ class FlightRecorder:
         ring_size: int = 256,
         state_fn=None,
         config=None,
+        events: frozenset | None = None,
     ):
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._state_fn = state_fn
         self._config = config_snapshot(config) if config is not None else {}
+        # Which event types trigger a dump.  The serving layer narrows this
+        # to its own eviction events so a job carrying BOTH a scheduler
+        # recorder and a service recorder never dumps one recovery twice.
+        self._events = RECOVERY_EVENTS if events is None else frozenset(events)
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(int(ring_size), 1))
         self._seq = 0
@@ -138,7 +144,7 @@ class FlightRecorder:
             self._ring.append(
                 {"mono": round(mono, 6), "type": etype, **fields}
             )
-            if etype not in RECOVERY_EVENTS:
+            if etype not in self._events:
                 return
             self._seq += 1
             seq = self._seq

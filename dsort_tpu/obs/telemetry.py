@@ -35,6 +35,7 @@ class Telemetry:
         self._in_flight = 0
         self._gauges: dict[str, float] = {"queue_depth": 0.0}
         self._slo: dict[tuple[str, str], LatencyHistogram] = {}
+        self._admissions: dict[tuple[str, str], int] = defaultdict(int)
 
     # -- ingestion ---------------------------------------------------------
 
@@ -60,6 +61,19 @@ class Telemetry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[str(name)] = float(value)
+
+    def inc_counter(self, name: str, by: int = 1) -> None:
+        """Directly bump a session counter (serving-layer events that have
+        no per-job `Metrics` to ride a ``job_done`` absorption on)."""
+        with self._lock:
+            self._counters[str(name)] += int(by)
+
+    def admission_verdict(self, tenant: str, reason: str) -> None:
+        """Count one admission verdict — the per-tenant backpressure series
+        (``dsort_admissions_total{tenant=,reason=}``) the serving layer
+        publishes on every `SortService.submit`."""
+        with self._lock:
+            self._admissions[(str(tenant), str(reason))] += 1
 
     def _job_started(self) -> None:
         with self._lock:
@@ -95,6 +109,9 @@ class Telemetry:
                 },
                 "jobs_in_flight": self._in_flight,
                 "gauges": dict(self._gauges),
+                "admissions": {
+                    f"{t}/{r}": n for (t, r), n in self._admissions.items()
+                },
                 "slo": {
                     f"{t}/{s}": h.snapshot() for (t, s), h in self._slo.items()
                 },
@@ -108,6 +125,7 @@ class Telemetry:
             jobs = dict(self._jobs)
             in_flight = self._in_flight
             gauges = dict(self._gauges)
+            admissions = dict(self._admissions)
             slo = dict(self._slo)
         lines = [
             "# HELP dsort_counter_total Registered framework counters "
@@ -133,6 +151,17 @@ class Telemetry:
                 f'dsort_jobs_total{{tenant="{tenant}",outcome="{outcome}"}} '
                 f"{jobs[(tenant, outcome)]}"
             )
+        if admissions:
+            lines.append(
+                "# HELP dsort_admissions_total Serving-layer admission "
+                "verdicts per tenant (serve.admission.ADMISSION_REASONS)."
+            )
+            lines.append("# TYPE dsort_admissions_total counter")
+            for (tenant, reason) in sorted(admissions):
+                lines.append(
+                    f'dsort_admissions_total{{tenant="{tenant}",'
+                    f'reason="{reason}"}} {admissions[(tenant, reason)]}'
+                )
         lines.append("# TYPE dsort_jobs_in_flight gauge")
         lines.append(f"dsort_jobs_in_flight {in_flight}")
         for name in sorted(gauges):

@@ -37,6 +37,19 @@ def render_top(parsed: dict) -> str:
     lines.append(
         f"jobs in flight: {int(in_flight)}    queue depth: {int(queue)}"
     )
+    # Compiled-variant cache (serving layer): entries/hits/misses/prewarmed
+    # ride as gauges; the hit rate is the headline the operator watches.
+    hits = parsed.get(("dsort_variant_cache_hits", ()), 0.0)
+    misses = parsed.get(("dsort_variant_cache_misses", ()), 0.0)
+    if hits or misses or ("dsort_variant_cache_entries", ()) in parsed:
+        entries = int(parsed.get(("dsort_variant_cache_entries", ()), 0.0))
+        prewarmed = int(parsed.get(("dsort_variant_cache_prewarmed", ()), 0.0))
+        rate = hits / (hits + misses) if (hits + misses) else 0.0
+        lines.append(
+            f"variant cache: {entries} entries    hits {int(hits)}  "
+            f"misses {int(misses)}  prewarmed {prewarmed}  "
+            f"hit rate {rate * 100:.1f}%"
+        )
     jobs = _labeled(parsed, "dsort_jobs_total")
     if jobs:
         lines.append("jobs:")
@@ -44,6 +57,14 @@ def render_top(parsed: dict) -> str:
             lines.append(
                 f"  {labels.get('tenant', '?'):<16} "
                 f"{labels.get('outcome', '?'):<8} {int(value):>8}"
+            )
+    admissions = _labeled(parsed, "dsort_admissions_total")
+    if admissions:
+        lines.append("admissions:")
+        for labels, value in admissions:
+            lines.append(
+                f"  {labels.get('tenant', '?'):<16} "
+                f"{labels.get('reason', '?'):<14} {int(value):>8}"
             )
     # SLO table: one row per (tenant, stage) with its quantile columns.
     slo: dict[tuple[str, str], dict] = {}

@@ -59,6 +59,7 @@ import numpy as np
 from dsort_tpu.ops.local_sort import sentinel_for
 
 __all__ = [
+    "ladder_rungs",
     "ring_caps",
     "ring_step_quantum",
     "ring_wire_bytes",
@@ -112,6 +113,29 @@ def check_ring_overflow(overflow) -> None:
 
 
 # -- adaptive per-step capacity (host side) ---------------------------------
+
+
+def ladder_rungs(hi: int, lo: int = 8) -> list[int]:
+    """Every 8-aligned 1/8-power-of-two capacity-ladder rung in [lo, hi].
+
+    THE enumeration of the rung vocabulary the whole tree quantizes to —
+    the fused pad sizes (`models.pipelines.pad_rung`), the ring step caps
+    (`ring_step_quantum`) and the all_to_all retry grid
+    (`sample_sort.cap_from_observed`) all land on these values (8 rungs
+    per octave, 8-aligned).  The serving layer's compiled-variant cache
+    prewarms exactly this list (`serve.SortService.prewarm`), so a cache
+    keyed on the ladder can be warm for EVERY size in a range with a
+    bounded number of compiles.
+    """
+    lo = max(int(lo), 8)
+    # Snap lo UP to its own rung so the walk below stays on the grid.
+    step = max(8, 1 << max((lo - 1).bit_length() - 3, 0))
+    r = -(-lo // step) * step
+    out: list[int] = []
+    while r <= hi:
+        out.append(r)
+        r += max(8, 1 << max(r.bit_length() - 3, 0))
+    return out
 
 
 def ring_step_quantum(n_local: int, num_workers: int) -> int:

@@ -534,6 +534,13 @@ class SpmdScheduler:
         # re-form invalidates them; an invalidated handle re-runs on the
         # current mesh at next use via the hook `sort` wires up.
         self._device_handles: list = []
+        #: Callables invoked with the list of newly-dead worker INDEXES on
+        #: every mesh re-form.  The serving layer (`serve.SortService`)
+        #: subscribes so a device lost under a full-mesh job also leaves
+        #: the small-job slice rotation instead of failing the next slice
+        #: dispatch.  Listener errors are swallowed: diagnostics must never
+        #: break a recovery path.
+        self.reform_listeners: list = []
 
     def _mesh_lane(self, key: tuple) -> _AttemptLane:
         with self._mesh_lanes_lock:
@@ -568,6 +575,14 @@ class SpmdScheduler:
         import weakref
 
         self._device_handles.append(weakref.ref(handle))
+
+    def _notify_reform(self, dead: list[int]) -> None:
+        """Tell subscribers which worker indexes a re-form just reaped."""
+        for listener in list(self.reform_listeners):
+            try:
+                listener(list(dead))
+            except Exception as e:  # a listener must never break recovery
+                log.warning("reform listener failed: %s", e)
 
     def _invalidate_handles(self, reason: str, metrics: Metrics) -> None:
         """Invalidate every outstanding device-resident handle.
@@ -1105,6 +1120,7 @@ class SpmdScheduler:
                 metrics.bump("mesh_reforms")
                 metrics.event("mesh_reform", survivors=len(live) - 1)
                 self._invalidate_handles("mesh_reform", metrics)
+                self._notify_reform([e.worker])
                 time.sleep(self.job.settle_delay_s)
             except ProgramWaitTimeout as e:
                 # The in-flight program wait lapsed — the hang the reference
@@ -1128,6 +1144,7 @@ class SpmdScheduler:
                         "mesh_reform", survivors=len(live) - len(dead)
                     )
                     self._invalidate_handles("mesh_reform", metrics)
+                    self._notify_reform(dead)
                 elif transient_retries < self.job.max_transient_retries:
                     transient_retries += 1
                     wait_lapses += 1
@@ -1165,6 +1182,7 @@ class SpmdScheduler:
                         "mesh_reform", survivors=len(live) - len(dead)
                     )
                     self._invalidate_handles("mesh_reform", metrics)
+                    self._notify_reform(dead)
                 elif transient_retries < self.job.max_transient_retries:
                     transient_retries += 1
                     metrics.bump("transient_retries")

@@ -1,0 +1,25 @@
+"""Multi-tenant serving layer (ROADMAP item 1; ARCHITECTURE §8).
+
+The event-driven successor of the reference's blocking job REPL
+(``server.c:160-167``): jobs are *submitted* (non-blocking) through typed
+admission control, queued per tenant, scheduled by weighted deficit round
+robin, and dispatched concurrently — small jobs packed onto disjoint mesh
+sub-slices through the fused single-program path, big jobs onto the full
+mesh through the SPMD scheduler — with the compiled-variant cache keyed on
+the capacity ladder so repeat-size jobs never recompile.  Exoshuffle
+(arXiv:2301.03734) is the blueprint: sorting as an application-level
+library over a shared futures runtime rather than a job-at-a-time binary.
+"""
+
+from dsort_tpu.serve.admission import (  # noqa: F401
+    ADMISSION_REASONS,
+    Admission,
+    AdmissionController,
+)
+from dsort_tpu.serve.fair import DeficitRoundRobin, parse_weights  # noqa: F401
+from dsort_tpu.serve.variants import VariantCache, fused_variant_key  # noqa: F401
+from dsort_tpu.serve.service import (  # noqa: F401
+    JobTicket,
+    ServiceClosed,
+    SortService,
+)

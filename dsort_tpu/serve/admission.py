@@ -1,0 +1,107 @@
+"""Typed admission control: the service's backpressure surface.
+
+Every ``SortService.submit`` returns an `Admission` verdict *before* any
+work is queued; callers never discover backpressure through an exception
+or a blocked call.  The verdict vocabulary (`ADMISSION_REASONS`) is part
+of the journal schema: an admitted job emits ``job_admitted``, a rejected
+one ``job_rejected`` with the same reason string, so the admission state
+machine is replayable from the journal alone (ARCHITECTURE §8).
+
+The controller itself is pure bookkeeping — the service calls it under its
+own condition-variable lock, so none of these methods take locks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: The full verdict vocabulary, journal- and test-enforced (ARCHITECTURE §8).
+ADMISSION_REASONS = (
+    "admitted",        # accepted: the job is queued for dispatch
+    "queue_full",      # global queue-depth limit reached (back off, retry)
+    "tenant_limit",    # this tenant's in-flight limit reached (tenant backs off)
+    "shutting_down",   # the service is draining; no new work is accepted
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Admission:
+    """One admission verdict: the typed backpressure signal.
+
+    ``queue_depth``/``tenant_depth`` snapshot the state the verdict was
+    computed against (AFTER the job was queued, for an admitted one), so a
+    client can implement load-aware backoff from the verdict alone.
+    """
+
+    admitted: bool
+    reason: str            # one of ADMISSION_REASONS
+    tenant: str
+    queue_depth: int       # jobs queued service-wide
+    tenant_depth: int      # this tenant's queued + running jobs
+
+    def __post_init__(self) -> None:
+        if self.reason not in ADMISSION_REASONS:
+            raise ValueError(
+                f"unknown admission reason {self.reason!r}; add it to "
+                "serve.admission.ADMISSION_REASONS"
+            )
+
+
+class AdmissionController:
+    """Bounded per-tenant in-flight and global queue-depth admission.
+
+    ``max_queue_depth`` bounds jobs *queued* (not yet dispatched)
+    service-wide; ``max_tenant_inflight`` bounds one tenant's queued plus
+    running jobs, so a single heavy tenant saturates its own budget before
+    it can fill the shared queue.
+    """
+
+    def __init__(self, max_queue_depth: int, max_tenant_inflight: int):
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}"
+            )
+        if max_tenant_inflight < 1:
+            raise ValueError(
+                f"max_tenant_inflight must be >= 1, got {max_tenant_inflight}"
+            )
+        self.max_queue_depth = max_queue_depth
+        self.max_tenant_inflight = max_tenant_inflight
+        self.queue_depth = 0
+        self._tenant_inflight: dict[str, int] = {}
+
+    def tenant_inflight(self, tenant: str) -> int:
+        return self._tenant_inflight.get(tenant, 0)
+
+    def consider(self, tenant: str, shutting_down: bool) -> Admission:
+        """The verdict for one submission; an admitted job is counted."""
+        depth = self.queue_depth
+        t_depth = self.tenant_inflight(tenant)
+        if shutting_down:
+            reason = "shutting_down"
+        elif depth >= self.max_queue_depth:
+            reason = "queue_full"
+        elif t_depth >= self.max_tenant_inflight:
+            reason = "tenant_limit"
+        else:
+            reason = "admitted"
+            self.queue_depth += 1
+            self._tenant_inflight[tenant] = t_depth + 1
+            depth, t_depth = depth + 1, t_depth + 1
+        return Admission(reason == "admitted", reason, tenant, depth, t_depth)
+
+    def dequeued(self) -> None:
+        """A queued job moved to dispatch (still counted against its tenant)."""
+        self.queue_depth = max(self.queue_depth - 1, 0)
+
+    def requeued(self) -> None:
+        """An evicted in-flight job went back on the queue (re-admission)."""
+        self.queue_depth += 1
+
+    def finished(self, tenant: str) -> None:
+        """A job left the service (done or failed): release the tenant slot."""
+        left = self.tenant_inflight(tenant) - 1
+        if left > 0:
+            self._tenant_inflight[tenant] = left
+        else:
+            self._tenant_inflight.pop(tenant, None)

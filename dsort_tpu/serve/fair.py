@@ -1,0 +1,101 @@
+"""Weighted deficit-round-robin over per-tenant job queues.
+
+Classic DRR (Shreedhar & Varghese) with job *cost* = key count: each
+tenant's queue accrues ``quantum * weight`` deficit per scheduler visit and
+may dispatch jobs while its deficit covers their cost, so a tenant
+submitting huge jobs consumes its share in keys, not in queue slots — one
+heavy tenant can delay the others by at most one quantum per round, never
+starve them.  An emptied queue resets its deficit (no hoarding credit
+while idle).
+
+Pure data structure: the service drives it under its own lock, so no
+locking here.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+def parse_weights(spec: str | None) -> dict[str, float]:
+    """``"acme=2,blue=1"`` -> ``{"acme": 2.0, "blue": 1.0}`` (None -> {})."""
+    out: dict[str, float] = {}
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, eq, value = item.partition("=")
+        if eq != "=" or not name.strip():
+            raise ValueError(
+                f"tenant weight {item!r} must be NAME=WEIGHT (e.g. acme=2)"
+            )
+        w = float(value)
+        if w <= 0:
+            raise ValueError(f"tenant weight for {name!r} must be > 0, got {w}")
+        out[name.strip()] = w
+    return out
+
+
+class DeficitRoundRobin:
+    """Per-tenant FIFO queues scheduled by weighted deficit round robin."""
+
+    def __init__(self, quantum: int = 1 << 18, weights: dict[str, float] | None = None):
+        if quantum < 1:
+            raise ValueError(f"quantum must be >= 1, got {quantum}")
+        self.quantum = int(quantum)
+        self.weights = dict(weights or {})
+        self._queues: dict[str, deque] = {}
+        self._deficit: dict[str, float] = {}
+        self._rotation: deque[str] = deque()  # active tenants, visit order
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def depths(self) -> dict[str, int]:
+        return {t: len(q) for t, q in self._queues.items() if q}
+
+    def weight_of(self, tenant: str) -> float:
+        return float(self.weights.get(tenant, 1.0))
+
+    def push(self, tenant: str, cost: int, item) -> None:
+        """Enqueue one job of ``cost`` key-units on ``tenant``'s queue."""
+        q = self._queues.get(tenant)
+        if q is None:
+            q = self._queues[tenant] = deque()
+        if not q:
+            # (Re)activation joins the END of the rotation with zero credit:
+            # a tenant cannot jump the round by letting its queue drain.
+            self._deficit[tenant] = 0.0
+            if tenant not in self._rotation:
+                self._rotation.append(tenant)
+        q.append((max(int(cost), 1), item))
+
+    def pop(self):
+        """The next ``(tenant, item)`` in DRR order, or None when empty.
+
+        Visits tenants in rotation; a visit grants ``quantum * weight``
+        deficit, and the tenant dispatches while its head job's cost is
+        covered.  Guaranteed to terminate: every full rotation strictly
+        increases some active tenant's deficit toward its bounded head
+        cost.
+        """
+        while self._rotation:
+            tenant = self._rotation[0]
+            q = self._queues.get(tenant)
+            if not q:
+                # Deactivate: deficit resets so idleness never banks credit.
+                self._rotation.popleft()
+                self._deficit.pop(tenant, None)
+                continue
+            cost, item = q[0]
+            if self._deficit[tenant] >= cost:
+                q.popleft()
+                self._deficit[tenant] -= cost
+                if not q:
+                    self._rotation.popleft()
+                    self._deficit.pop(tenant, None)
+                return tenant, item
+            # Not covered yet: grant this visit's quantum and move on.
+            self._deficit[tenant] += self.quantum * self.weight_of(tenant)
+            self._rotation.rotate(-1)
+        return None

@@ -1,0 +1,725 @@
+"""`SortService`: the event-driven multi-tenant serving core.
+
+Replaces the serve loop's blocking one-job-at-a-time execution with an
+async pipeline (ARCHITECTURE §8):
+
+  submit() ──Admission──▶ per-tenant queues ──DRR──▶ dispatcher ──▶ mesh
+  (non-blocking verdict)   (bounded depth)   (weighted fair)      packing
+
+- **Admission** (`serve.admission`): a typed verdict per submission —
+  bounded global queue depth and per-tenant in-flight caps; rejected work
+  is a return value, never an exception or a blocked caller.  Verdicts are
+  journaled (``job_admitted``/``job_rejected``) and counted per tenant on
+  the metrics endpoint.
+- **Fair scheduling** (`serve.fair`): weighted deficit round robin over
+  per-tenant FIFO queues, cost = key count — one heavy tenant cannot
+  starve the rest, asserted from the journal (``job_dequeued`` carries the
+  measured queue wait).
+- **Mesh-slice packing**: the device list splits into fixed sub-slices;
+  small jobs (< ``small_job_max``) dispatch concurrently onto free slices
+  through the fused single-program path (`models.pipelines`), big jobs
+  take the WHOLE mesh through `SpmdScheduler` (all slices leased at once).
+  The existing fault contract is preserved: a device loss inside the SPMD
+  path re-forms and re-runs as before; a loss on a slice evicts the job
+  (``job_evicted`` — one flight-recorder bundle per eviction), re-admits
+  it (``job_readmitted``), and quarantines the slice behind a probe.
+- **Compiled-variant cache** (`serve.variants`): fused programs are cached
+  per capacity-ladder rung with LRU bounds and journaled hit/miss
+  counters; `prewarm` compiles the ladder's rungs at startup so the first
+  job of a size never pays the compile.
+
+Graceful shutdown: `shutdown(drain=True)` stops admission (verdict
+``shutting_down``), completes every queued and in-flight job, journals
+``serve_drain``/``serve_stop``, and flushes the journal.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from dsort_tpu.config import JobConfig, ServeConfig
+from dsort_tpu.scheduler.fault import (
+    JobFailedError,
+    ProgramWaitTimeout,
+    WorkerFailure,
+    classify_runtime_error,
+)
+from dsort_tpu.serve.admission import Admission, AdmissionController
+from dsort_tpu.serve.fair import DeficitRoundRobin
+from dsort_tpu.serve.variants import VariantCache, fused_variant_key, spmd_variant_key
+from dsort_tpu.utils.logging import get_logger
+from dsort_tpu.utils.metrics import Metrics, PhaseTimer
+
+log = get_logger("serve")
+
+
+class ServiceClosed(RuntimeError):
+    """The service is shut down; the job was not (or will not be) run."""
+
+
+class JobTicket:
+    """Future-style handle for one admitted job."""
+
+    def __init__(self, data: np.ndarray, tenant: str, job_id: str | None,
+                 ckpt_job_id: str | None, metrics: Metrics):
+        self.data = data
+        self.tenant = tenant
+        self.job_id = job_id
+        self.ckpt_job_id = ckpt_job_id
+        self.metrics = metrics
+        self.n_keys = len(data)
+        self.readmits = 0
+        self.admitted_mono = time.monotonic()
+        self.queued_mono = self.admitted_mono  # reset on re-admission
+        self._done = threading.Event()
+        self._result: np.ndarray | None = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"job {self.job_id or self.metrics._job_ordinal()} not done "
+                f"within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class SortService:
+    """Async job queue + fair scheduler + mesh packing + variant cache."""
+
+    def __init__(
+        self,
+        devices=None,
+        job: JobConfig | None = None,
+        serve: ServeConfig | None = None,
+        telemetry=None,
+        journal=None,
+        journal_path: str | None = None,
+        injector=None,
+        runner=None,
+        start: bool = True,
+    ):
+        self.job = job or JobConfig()
+        self.serve = serve or ServeConfig()
+        self.telemetry = telemetry
+        self.journal = journal
+        self.journal_path = journal_path
+        self._injector = injector
+        self._runner = runner
+        self._cv = threading.Condition()
+        self._flush_lock = threading.Lock()
+        self._shutdown = False
+        self._closed = False
+        self._done_jobs = 0
+        self._failed_jobs = 0
+        self._admission = AdmissionController(
+            self.serve.max_queue_depth, self.serve.max_tenant_inflight
+        )
+        self._drr = DeficitRoundRobin(
+            quantum=self.serve.drr_quantum_keys,
+            weights=dict(self.serve.tenant_weights),
+        )
+        self.variants = VariantCache(self.serve.variant_cache_entries)
+        self._inflight: dict = {}  # ticket -> allocated slice ids
+        if runner is None:
+            import jax
+
+            from dsort_tpu.scheduler import SpmdScheduler
+
+            devs = list(devices) if devices is not None else jax.devices()
+            self._sched = SpmdScheduler(
+                devices=devs, job=self.job, injector=injector,
+                telemetry=telemetry,
+            )
+            # A device reaped under a FULL-mesh job must also leave the
+            # small-job slice rotation — probe-gated, same as eviction.
+            self._sched.reform_listeners.append(self._on_mesh_reform)
+            self._devices = devs
+            self._dev_index = {d: i for i, d in enumerate(devs)}
+            s = max(min(self.serve.slice_devices, len(devs)), 1)
+            groups = [devs[i: i + s] for i in range(0, len(devs) - s + 1, s)]
+            self._slices = {i: g for i, g in enumerate(groups or [devs])}
+        else:
+            self._sched = None
+            self._devices = []
+            self._dev_index = {}
+            # Runner mode (local / taskpool sorters own the whole backend):
+            # one execution slot, no packing — the queue, admission, fairness
+            # and shutdown semantics still apply.
+            self._slices = {0: None}
+        self._free = set(self._slices)
+        self._small_max = self.serve.small_job_max
+        if self._small_max is None:
+            from dsort_tpu.models.pipelines import FUSED_SMALL_JOB_MAX
+
+            self._small_max = FUSED_SMALL_JOB_MAX
+        # Service-level metrics: rejections and lifecycle events that have
+        # no per-job Metrics to ride on.
+        self._svc_metrics = Metrics(journal=journal)
+        if telemetry is not None:
+            telemetry.attach(self._svc_metrics)
+        self.flight = None
+        if self.job.flight_recorder_dir:
+            from dsort_tpu.obs.flight import FlightRecorder
+
+            # The service recorder dumps ONLY evictions: the schedulers'
+            # own recorders already cover mesh re-forms / capacity retries,
+            # and a second dump of the same event would double-count.
+            self.flight = FlightRecorder(
+                self.job.flight_recorder_dir,
+                ring_size=self.job.flight_ring_size,
+                state_fn=self._flight_state,
+                config=self.job,
+                events=frozenset({"job_evicted"}),
+            )
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(len(self._slices), 1),
+            thread_name_prefix="dsort-serve",
+        )
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="dsort-serve-dispatch"
+        )
+        self._started = False
+        self._publish_gauges()
+        if start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the dispatcher (idempotent; ``start=False`` lets tests
+        queue a whole workload before any dispatch happens)."""
+        if not self._started:
+            self._started = True
+            self._dispatcher.start()
+
+    def __enter__(self) -> "SortService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=True)
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(
+        self,
+        data: np.ndarray,
+        tenant: str | None = None,
+        job_id: str | None = None,
+        ckpt_job_id: str | None = None,
+    ) -> tuple[Admission, JobTicket | None]:
+        """Admit one keys-only sort job; returns ``(verdict, ticket)``.
+
+        Non-blocking: backpressure is the verdict, not a blocked caller.
+        ``job_id`` is a client label (journal only); ``ckpt_job_id``
+        additionally routes the job through the checkpointed full-mesh
+        path when ``JobConfig.checkpoint_dir`` is set.
+        """
+        data = np.asarray(data)
+        tenant = tenant or self.job.tenant
+        with self._cv:
+            verdict = self._admission.consider(tenant, self._shutdown)
+        if self.telemetry is not None:
+            self.telemetry.admission_verdict(tenant, verdict.reason)
+        if not verdict.admitted:
+            self._svc_metrics.bump("jobs_rejected")
+            self._svc_metrics.event(
+                "job_rejected", tenant=tenant, reason=verdict.reason,
+                queue_depth=verdict.queue_depth, n_keys=len(data),
+            )
+            log.warning(
+                "job rejected for tenant %s: %s (queue_depth=%d)",
+                tenant, verdict.reason, verdict.queue_depth,
+            )
+            return verdict, None
+        metrics = Metrics(journal=self.journal)
+        if self.telemetry is not None:
+            self.telemetry.attach(metrics)
+        if self.flight is not None:
+            self.flight.attach(metrics)
+        ticket = JobTicket(data, tenant, job_id, ckpt_job_id, metrics)
+        metrics.bump("jobs_admitted")
+        metrics.event(
+            "job_admitted", tenant=tenant, queue_depth=verdict.queue_depth,
+            n_keys=len(data),
+        )
+        # The SLO 'admit' stamp: job_start at ADMISSION time, so the
+        # existing admit_to_dispatch histogram IS the queue wait.  The
+        # executing scheduler's own job_start on the same ordinal is a
+        # recognized duplicate (obs.slo) and keeps its admission stamp.
+        metrics.event(
+            "job_start", mode="serve", n_keys=len(data), job_id=job_id,
+            tenant=tenant,
+        )
+        with self._cv:
+            self._drr.push(tenant, max(len(data), 1), ticket)
+            self._cv.notify_all()
+        self._publish_gauges()
+        return verdict, ticket
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _is_big(self, ticket: JobTicket) -> bool:
+        if self._runner is not None:
+            return False
+        if ticket.ckpt_job_id and self.job.checkpoint_dir:
+            # Resumable jobs take the checkpointed full-mesh path no matter
+            # the size (same rule as the CLI's small-job auto-route).
+            return True
+        return ticket.n_keys >= self._small_max
+
+    def _resources_free_locked(self, big: bool) -> bool:
+        if not self._slices:
+            return True  # every slice retired: dispatch fails loudly below
+        if big:
+            return len(self._free) == len(self._slices)
+        return bool(self._free)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                nxt = None
+                while nxt is None:
+                    nxt = self._drr.pop()
+                    if nxt is not None:
+                        self._admission.dequeued()
+                        break
+                    # Drain-exit only when nothing is queued, in flight, OR
+                    # admitted-but-not-yet-pushed: submit() counts the job
+                    # in queue_depth (consider()) BEFORE the later lock
+                    # block pushes it, so a racing submit can't strand a
+                    # ticket behind a dispatcher that already exited.
+                    if (
+                        self._shutdown
+                        and not self._inflight
+                        and self._admission.queue_depth == 0
+                    ):
+                        return
+                    self._cv.wait(timeout=0.05)
+                tenant, ticket = nxt
+                big = self._is_big(ticket)
+                while not self._resources_free_locked(big):
+                    self._cv.wait(timeout=0.05)
+                if not self._slices:
+                    alloc = ()
+                else:
+                    alloc = (
+                        tuple(sorted(self._free)) if big
+                        else (min(self._free),)
+                    )
+                self._free.difference_update(alloc)
+                self._inflight[ticket] = alloc
+            self._publish_gauges()
+            if not alloc and self._runner is None:
+                self._finish_error(
+                    ticket,
+                    JobFailedError("no live mesh slices remain"),
+                    alloc,
+                )
+                continue
+            wait_s = time.monotonic() - ticket.queued_mono
+            ticket.metrics.event(
+                "job_dequeued", tenant=tenant, wait_s=round(wait_s, 6),
+                big=big, slices=list(alloc),
+            )
+            self._pool.submit(self._execute, ticket, alloc, big)
+
+    # -- execution ----------------------------------------------------------
+
+    def _execute(self, ticket: JobTicket, alloc: tuple, big: bool) -> None:
+        try:
+            if self._runner is not None:
+                out = self._runner(
+                    ticket.data, ticket.metrics, job_id=ticket.ckpt_job_id
+                )
+            elif big:
+                out = self._sort_big(ticket)
+            else:
+                out = self._sort_small(ticket, alloc[0])
+        except BaseException as e:
+            if not big and self._should_readmit(ticket, e):
+                self._evict_and_readmit(ticket, alloc, e)
+            else:
+                self._finish_error(ticket, e, alloc)
+        else:
+            self._finish_ok(ticket, out, alloc)
+
+    def _sort_big(self, ticket: JobTicket) -> np.ndarray:
+        m = ticket.metrics
+        m.bump("fullmesh_dispatches")
+        self.variants.note(
+            spmd_variant_key(
+                ticket.n_keys, len(self._devices),
+                str(ticket.data.dtype), self.job.local_kernel,
+                self.job.capacity_factor, self.job.exchange,
+            ),
+            metrics=m,
+        )
+        self._publish_gauges()
+        return self._sched.sort(
+            ticket.data, metrics=m, job_id=ticket.ckpt_job_id
+        )
+
+    def _sort_small(self, ticket: JobTicket, sid: int) -> np.ndarray:
+        from dsort_tpu.models.pipelines import fused_sort_small, pad_rung
+        from dsort_tpu.ops.float_order import is_float_key_dtype
+
+        m = ticket.metrics
+        data = ticket.data
+        devs = self._slices[sid]
+        worker = self._dev_index.get(devs[0], 0)
+        m.event("attempt_start", worker=worker, slice=sid)
+        m.bump("slice_dispatches")
+        if self._injector is not None:
+            self._injector.check(worker, "slice")
+        if is_float_key_dtype(data.dtype) or len(data) == 0:
+            # Rare paths keep the plain fused route (no device pinning):
+            # float keys remap through ops.float_order inside.  Still
+            # bounded — the fused fetch is the completion barrier, and a
+            # wedged default device must lapse, not pin the pool thread.
+            out = self._sched.run_bounded(
+                lambda: fused_sort_small(data, self.job.local_kernel, m),
+                n_keys=len(data), tag=f"slice{sid}",
+                lane_key=("slice", devs[0].id),
+            )
+        else:
+            import jax
+
+            from dsort_tpu.models.pipelines import _fused_small_fn, pad_for_fused
+
+            n = len(data)
+            dtype_str = str(data.dtype)
+            kernel = self.job.local_kernel
+            fn = self.variants.get_or_build(
+                fused_variant_key(n, dtype_str, kernel),
+                lambda: _fused_small_fn(pad_rung(n), dtype_str, kernel),
+                metrics=m,
+            )
+            timer = PhaseTimer(m)
+            with timer.phase("partition"):
+                x = jax.device_put(pad_for_fused(data), devs[0])
+            with timer.phase("local_sort"):
+                # Bounded like every other in-flight program, INCLUDING the
+                # blocking np.asarray fetch (jax dispatch is async — without
+                # the fetch inside, a wedged slice device would pin the pool
+                # thread past the lapse): on lapse the eviction path
+                # re-admits the job elsewhere.
+                out = self._sched.run_bounded(
+                    lambda: np.asarray(fn(x, np.int32(n))),
+                    n_keys=n, tag=f"slice{sid}",
+                    lane_key=("slice", devs[0].id),
+                )[:n]
+        m.bump("fused_small_jobs")
+        m.event("job_done", n_keys=len(data), counters=dict(m.counters))
+        self._publish_gauges()
+        return out
+
+    # -- fault handling -----------------------------------------------------
+
+    def _should_readmit(self, ticket: JobTicket, e: BaseException) -> bool:
+        faulty = isinstance(e, (WorkerFailure, ProgramWaitTimeout)) or (
+            classify_runtime_error(e) is not None
+        )
+        return faulty and ticket.readmits < max(len(self._slices), 1)
+
+    def _evict_and_readmit(
+        self, ticket: JobTicket, alloc: tuple, e: BaseException
+    ) -> None:
+        """Slice-job fault: evict (one flight bundle), re-admit, quarantine.
+
+        The slice's lead device is probed before rejoining the free pool;
+        a failed probe retires the slice — the serving-layer analogue of
+        the SPMD path's mesh re-form over survivors.
+        """
+        m = ticket.metrics
+        ticket.readmits += 1
+        reason = (str(e).splitlines() or [repr(e)])[0][:120]
+        m.event(
+            "job_evicted", tenant=ticket.tenant, reason=reason,
+            slice=alloc[0] if alloc else None, readmits=ticket.readmits,
+        )
+        m.bump("jobs_readmitted")
+        m.event(
+            "job_readmitted", tenant=ticket.tenant, readmits=ticket.readmits
+        )
+        log.warning(
+            "job evicted from slice %s (%s); re-admitting (attempt %d)",
+            alloc, reason, ticket.readmits,
+        )
+        # Re-queue BEFORE releasing the in-flight slot: the dispatcher's
+        # shutdown-drain exit condition is "queue empty and nothing in
+        # flight", and the reverse order would open a window where an
+        # evicted job is in neither set and the drain exits without it.
+        ticket.queued_mono = time.monotonic()
+        with self._cv:
+            self._admission.requeued()
+            self._drr.push(ticket.tenant, max(ticket.n_keys, 1), ticket)
+            self._cv.notify_all()
+        self._release(ticket, alloc, probe=True)
+        self._publish_gauges()
+
+    def _on_mesh_reform(self, dead_workers: list) -> None:
+        """A full-mesh job's re-form reaped devices: retire their FREE
+        slices now (probe-gated — a transiently-failed device whose probe
+        passes keeps its slice) instead of failing the next small job
+        dispatched there.  Allocated slices resolve through their own
+        eviction path when their job fails."""
+        dead = set(dead_workers)
+        with self._cv:
+            # No free-check: a full-mesh job holds EVERY slice while its
+            # re-form fires, and `_release` skips ids already retired here.
+            suspects = [
+                sid for sid, devs in self._slices.items()
+                if devs and self._dev_index.get(devs[0]) in dead
+            ]
+        retired = []
+        for sid in suspects:
+            if self._probe_slice(sid):
+                continue
+            with self._cv:
+                if sid in self._slices:
+                    del self._slices[sid]
+                    self._free.discard(sid)
+                    retired.append(sid)
+                self._cv.notify_all()
+        for sid in retired:
+            self._svc_metrics.event(
+                "slice_retired", slice=sid, reason="mesh_reform"
+            )
+            log.warning(
+                "slice %d retired after a full-mesh re-form; %d slices "
+                "remain", sid, len(self._slices),
+            )
+
+    def _probe_slice(self, sid: int) -> bool:
+        devs = self._slices.get(sid)
+        if devs is None or self._sched is None:
+            return True
+        worker = self._dev_index.get(devs[0])
+        if worker is None:
+            return True
+        return self._sched._probe_device(worker)
+
+    def _release(self, ticket: JobTicket, alloc: tuple, probe: bool = False) -> None:
+        # Probes are bounded DEVICE calls — they run before the lock, never
+        # under it (a wedged device must stall its own probe, not the whole
+        # service's dispatch plane).
+        dead = [sid for sid in alloc if probe and not self._probe_slice(sid)]
+        retired = []
+        with self._cv:
+            self._inflight.pop(ticket, None)
+            for sid in alloc:
+                if sid not in self._slices:
+                    continue
+                if sid in dead:
+                    del self._slices[sid]
+                    self._free.discard(sid)
+                    retired.append(sid)
+                else:
+                    self._free.add(sid)
+            self._cv.notify_all()
+        for sid in retired:
+            self._svc_metrics.event("slice_retired", slice=sid)
+            log.warning(
+                "slice %d retired after a failed probe; %d slices remain",
+                sid, len(self._slices),
+            )
+
+    # -- completion ---------------------------------------------------------
+
+    def _finish_ok(self, ticket: JobTicket, out: np.ndarray, alloc: tuple) -> None:
+        # The 'fetched' SLO boundary: the sorted keys are host-resident here.
+        ticket.metrics.event("result_fetch", n_keys=len(out))
+        self._release(ticket, alloc)
+        with self._cv:
+            self._admission.finished(ticket.tenant)
+            self._done_jobs += 1
+        ticket.data = None  # a long session must not pin every input array
+        ticket._result = out
+        ticket._done.set()
+        self._publish_gauges()
+        self._flush_journal()
+
+    def _finish_error(self, ticket: JobTicket, e: BaseException, alloc: tuple) -> None:
+        # Close the job on the telemetry side even when the executing
+        # scheduler did not reach its own clean job_failed (same rule as
+        # cli._run_one): duplicates are no-ops for the taps.
+        ticket.metrics.event(
+            "job_failed",
+            reason=(str(e).splitlines() or [repr(e)])[0][:120],
+            counters=dict(ticket.metrics.counters),
+        )
+        self._release(ticket, alloc, probe=True)
+        with self._cv:
+            self._admission.finished(ticket.tenant)
+            self._failed_jobs += 1
+        ticket._error = e
+        ticket._done.set()
+        log.error("job for tenant %s failed: %s", ticket.tenant, e)
+        self._publish_gauges()
+        self._flush_journal()
+
+    # -- variant prewarm ----------------------------------------------------
+
+    def prewarm(self, sizes=None) -> int:
+        """Compile the capacity ladder's fused rungs before traffic.
+
+        ``sizes`` (key counts; default: every ladder rung in
+        ``[serve.prewarm_min_keys, serve.prewarm_max_keys]``) map to their
+        rungs, compile once per rung, and execute once on every slice's
+        lead device so per-device executables exist too.  Returns the
+        number of fresh rungs compiled.
+        """
+        if self._runner is not None:
+            return 0
+        import jax
+
+        from dsort_tpu.models.pipelines import _fused_small_fn, pad_rung
+        from dsort_tpu.parallel.exchange import ladder_rungs
+
+        if sizes is None:
+            rungs = ladder_rungs(
+                self.serve.prewarm_max_keys, lo=self.serve.prewarm_min_keys
+            )
+        else:
+            rungs = sorted({pad_rung(max(int(n), 1)) for n in sizes})
+        dtype_str = str(np.dtype(self.job.key_dtype))
+        kernel = self.job.local_kernel
+        leads = [g[0] for g in self._slices.values()]
+        fresh = 0
+        for rung in rungs:
+            key = fused_variant_key(rung, dtype_str, kernel)
+            fn, built = self.variants.prewarm(
+                key, lambda r=rung: _fused_small_fn(r, dtype_str, kernel)
+            )
+            # One execution per lead device: jit specializes per placement,
+            # so compiling on device 0 alone would leave 7 cold slices.
+            zero = np.zeros(rung, np.dtype(self.job.key_dtype))
+            for dev in leads:
+                np.asarray(fn(jax.device_put(zero, dev), np.int32(rung))[:1])
+            if built:
+                fresh += 1
+        if fresh:
+            if self.telemetry is not None:
+                self.telemetry.inc_counter("variant_cache_prewarms", fresh)
+            self._svc_metrics.event(
+                "variant_prewarm", n=fresh, rungs=[int(r) for r in rungs],
+            )
+            log.info(
+                "prewarmed %d compiled variant rung(s) across %d slice(s)",
+                fresh, len(leads),
+            )
+        self._publish_gauges()
+        return fresh
+
+    # -- telemetry ----------------------------------------------------------
+
+    def _publish_gauges(self) -> None:
+        if self.telemetry is None:
+            return
+        stats = self.variants.stats()
+        with self._cv:
+            depth = self._admission.queue_depth
+            free = len(self._free)
+        self.telemetry.set_gauge("queue_depth", depth)
+        self.telemetry.set_gauge("slices_free", free)
+        self.telemetry.set_gauge("variant_cache_entries", stats["entries"])
+        self.telemetry.set_gauge("variant_cache_hits", stats["hits"])
+        self.telemetry.set_gauge("variant_cache_misses", stats["misses"])
+        self.telemetry.set_gauge("variant_cache_prewarmed", stats["prewarmed"])
+
+    def _flight_state(self) -> dict:
+        return {
+            "mode": "serve",
+            "slices": {str(k): [d.id for d in v] for k, v in self._slices.items()
+                       if v is not None},
+            "free": sorted(self._free),
+            "queued": self._admission.queue_depth,
+            "in_flight": len(self._inflight),
+        }
+
+    def _flush_journal(self) -> None:
+        if self.journal is not None and self.journal_path:
+            with self._flush_lock:
+                self.journal.flush_jsonl(self.journal_path)
+
+    # -- introspection ------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._admission.queue_depth
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "queued": self._admission.queue_depth,
+                "in_flight": len(self._inflight),
+                "done": self._done_jobs,
+                "failed": self._failed_jobs,
+                "slices": len(self._slices),
+                "slices_free": len(self._free),
+                "variant_cache": self.variants.stats(),
+            }
+
+    # -- shutdown -----------------------------------------------------------
+
+    def shutdown(self, drain: bool = True, timeout: float | None = None) -> bool:
+        """Stop admission and wind the service down.
+
+        ``drain=True`` (the default, and what SIGINT/SIGTERM trigger in
+        ``dsort serve``) completes every queued and in-flight job before
+        returning; ``drain=False`` fails queued jobs with `ServiceClosed`
+        and only waits for the in-flight ones.  Returns True when the
+        service wound down inside ``timeout``.
+        """
+        dropped = []
+        with self._cv:
+            if self._closed:
+                return True
+            first = not self._shutdown
+            self._shutdown = True
+            queued, in_flight = len(self._drr), len(self._inflight)
+            if not drain:
+                while True:
+                    nxt = self._drr.pop()
+                    if nxt is None:
+                        break
+                    self._admission.dequeued()
+                    dropped.append(nxt[1])
+            self._cv.notify_all()
+        if first:
+            self._svc_metrics.event(
+                "serve_drain", reason="shutdown", drain=bool(drain),
+                queued=queued, in_flight=in_flight,
+            )
+        for ticket in dropped:
+            self._finish_error(
+                ticket, ServiceClosed("service shutting down"), ()
+            )
+        if drain and not self._started:
+            # A paused service still owes its queued jobs a drain.
+            self.start()
+        if self._started and self._dispatcher.is_alive():
+            self._dispatcher.join(timeout=timeout)
+            if self._dispatcher.is_alive():
+                return False
+        self._pool.shutdown(wait=True)
+        with self._cv:
+            self._closed = True
+            done, failed = self._done_jobs, self._failed_jobs
+        self._svc_metrics.event(
+            "serve_stop", jobs_done=done, jobs_failed=failed,
+            counters=dict(self._svc_metrics.counters),
+        )
+        self._publish_gauges()
+        self._flush_journal()
+        return True

@@ -74,6 +74,27 @@ EVENT_TYPES: dict[str, str] = {
                     "'fetched' stage boundary of the SLO histograms",
     "flight_dump": "the fault flight recorder dumped a postmortem bundle "
                    "(path, recovery_path)",
+    # Serving layer (dsort_tpu.serve, ARCHITECTURE §8):
+    "job_admitted": "admission control accepted a job into the service "
+                    "queue (tenant, queue_depth, n_keys)",
+    "job_rejected": "admission control rejected a job (tenant, reason — "
+                    "one of serve.admission.ADMISSION_REASONS)",
+    "job_dequeued": "the fair scheduler dequeued a job for dispatch "
+                    "(tenant, wait_s — the measured queue wait, big, "
+                    "slices)",
+    "job_evicted": "a fault evicted a queued/in-flight job from its mesh "
+                   "slice (tenant, reason, slice, readmits) — dumps one "
+                   "flight-recorder bundle per eviction",
+    "job_readmitted": "an evicted job re-entered the service queue "
+                      "(tenant, readmits)",
+    "slice_retired": "a mesh sub-slice failed its liveness probe and left "
+                     "the packing rotation (slice)",
+    "variant_prewarm": "compiled-variant cache rungs were prewarmed at "
+                       "startup (n, rungs)",
+    "serve_drain": "the service began draining — no new admissions "
+                   "(reason, drain, queued, in_flight)",
+    "serve_stop": "the service wound down; the journal's close event "
+                  "(jobs_done, jobs_failed, counters)",
 }
 
 #: THE counter registry: every `Metrics.bump` name in the package, with its
@@ -116,6 +137,16 @@ COUNTERS: dict[str, str] = {
     "exchange_bytes_saved": "wire bytes the ring schedule avoided vs the "
                             "policy-sized padded all_to_all",
     "flight_dumps": "postmortem bundles dumped by the fault flight recorder",
+    "jobs_admitted": "jobs accepted by the serving layer's admission control",
+    "jobs_rejected": "jobs rejected by admission control (typed verdict)",
+    "jobs_readmitted": "evicted jobs re-admitted to the service queue",
+    "slice_dispatches": "small jobs packed onto mesh sub-slices",
+    "fullmesh_dispatches": "big jobs dispatched onto the full SPMD mesh",
+    "variant_cache_hits": "compiled-variant cache hits (rung already cached)",
+    "variant_cache_misses": "compiled-variant cache misses (rung compiled)",
+    "variant_cache_evictions": "compiled variants dropped by the LRU bound",
+    "variant_cache_prewarms": "compiled-variant rungs built by the startup "
+                              "prewarm pass",
 }
 
 

@@ -107,3 +107,9 @@ def test_in_tree_trajectory_compares(tmp_path):
     assert any("ratio" in r for r in rows) or any(
         r["class"] in ("added", "removed") for r in rows
     )
+    # r06 -> r07 (ISSUE 7): the serving-layer row joins the trajectory as
+    # an 'added' metric and the comparison parses end to end.
+    r07 = os.path.join(repo, "BENCH_r07.jsonl")
+    rows = bench.compare_artifacts(new, r07)
+    added = {r["metric"] for r in rows if r["class"] == "added"}
+    assert "service_mixed_workload_8dev_cpu_mesh" in added
