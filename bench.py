@@ -99,6 +99,15 @@ BENCH_SCHEMA_FIELD_TYPES = {
     "tenants": "num",
     "prewarmed": "num",
     "slices": "num",
+    # Introspection-plane cost row (`dsort bench --analyze-smoke`, ISSUE 9):
+    "overhead_frac": "num",
+    "bare_keys_per_sec": "num",
+    "journaled_keys_per_sec": "num",
+    "dominant_phase": "str",
+    "skew_ratio_zipf": "num",
+    "skew_ratio_uniform": "num",
+    "hbm_watermark_bytes": "num",
+    "introspection_ok": "bool",
 }
 
 _SCHEMA_TYPE_CHECKS = {
@@ -1064,6 +1073,43 @@ print(json.dumps({
     except Exception as e:  # the ladder must never sink the artifact
         _emit(
             "service_mixed_workload_8dev_cpu_mesh", 0.0, "jobs/sec",
+            baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
+
+    # Introspection-plane cost row (ISSUE 9): the same ring sort with and
+    # without journal+ledger+memwatch attached, plus the zipf-vs-uniform
+    # skew-report margin.  The harness is `dsort bench --analyze-smoke` —
+    # ONE copy of the contract, shared with `make profile-smoke` — and the
+    # row proves observing costs < 5% of e2e (`introspection_ok`).
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "dsort_tpu.cli", "bench",
+                "--analyze-smoke", "--n", str(1 << 20), "--reps", "2",
+            ],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        rows = []
+        for ln in r.stdout.strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        for row in rows:
+            row["metric"] += "_8dev_cpu_mesh"
+            _emit_line(row)
+        if not rows:
+            raise RuntimeError(
+                f"analyze-smoke emitted no rows (rc {r.returncode}): "
+                + (r.stderr.strip().splitlines() or ["no stderr"])[-1][:160]
+            )
+    except Exception as e:  # the ladder must never sink the artifact
+        _emit(
+            "analyze_overhead_1M_8dev_cpu_mesh", 0.0, "frac",
             baseline=False,
             error=(str(e).splitlines() or [repr(e)])[0][:200],
         )

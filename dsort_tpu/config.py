@@ -230,6 +230,12 @@ class ServeConfig:
     prewarm: bool = False            # compile the ladder's rungs at startup
     prewarm_min_keys: int = 1 << 14
     prewarm_max_keys: int = 1 << 16
+    # SLO-driven admission shedding (--slo-shed-ms): reject with the typed
+    # verdict `slo_shed` when a tenant's live p95 queue wait (a sliding
+    # window of measured job_dequeued waits) exceeds this target while work
+    # is still queued; an empty queue always admits, so shedding recovers
+    # by itself once the backlog drains.  None = disabled.
+    slo_shed_ms: float | None = None
 
     def __post_init__(self) -> None:
         if self.max_queue_depth < 1:
@@ -267,6 +273,10 @@ class ServeConfig:
                 "prewarm range must satisfy 0 < min <= max, got "
                 f"[{self.prewarm_min_keys}, {self.prewarm_max_keys}]"
             )
+        if self.slo_shed_ms is not None and self.slo_shed_ms <= 0:
+            raise ConfigError(
+                f"slo_shed_ms must be > 0, got {self.slo_shed_ms}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -292,7 +302,8 @@ class SortConfig:
         ``TENANT``, ``FLIGHT_DIR``) and serving-layer keys
         (``SERVE_QUEUE_DEPTH``, ``SERVE_TENANT_INFLIGHT``,
         ``SERVE_SLICE_DEVICES``, ``SERVE_SMALL_JOB_MAX``,
-        ``SERVE_WEIGHTS`` — ``tenant=weight,...`` — and ``SERVE_PREWARM``).
+        ``SERVE_WEIGHTS`` — ``tenant=weight,...`` — ``SERVE_PREWARM``,
+        and ``SERVE_SLO_SHED_MS``).
         """
         def geti(key: str, default: int | None) -> int | None:
             return int(m[key]) if key in m else default
@@ -332,6 +343,10 @@ class SortConfig:
             tenant_weights=parse_weights(m.get("SERVE_WEIGHTS")),
             prewarm=m.get("SERVE_PREWARM", "0").strip().lower()
             in ("1", "true", "yes"),
+            slo_shed_ms=(
+                float(m["SERVE_SLO_SHED_MS"])
+                if "SERVE_SLO_SHED_MS" in m else None
+            ),
         )
         return cls(
             mesh=mesh,

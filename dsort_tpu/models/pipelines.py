@@ -78,14 +78,22 @@ def pad_for_fused(data: np.ndarray) -> np.ndarray:
 
 @functools.lru_cache(maxsize=64)
 def _fused_small_fn(n_pad: int, dtype_str: str, kernel: str):
-    del dtype_str  # part of the cache key; the jit re-specializes by dtype
+    from dsort_tpu.obs.prof import instrument_jit
 
     @jax.jit
     def f(x, count):
         out, _ = sort_padded(x, count, kernel)
         return out
 
-    return f
+    # Ledger key == the compiled-variant cache key (`serve.variants.
+    # fused_variant_key`): ("fused", rung, dtype, kernel) — so every
+    # VariantCache entry has a matching compile/cost/HBM ledger row.
+    # ``dtype_str`` rides in the key only; the jit still specializes per
+    # call dtype/placement, and each placement records its own compile
+    # (the serve prewarm compiles one executable per slice lead).
+    return instrument_jit(
+        f, key_fn=lambda *a: ("fused", n_pad, dtype_str, kernel)
+    )
 
 
 def fused_sort_small(
@@ -154,6 +162,9 @@ def fused_sort_small(
             out, shard_lengths=np.array([n], np.int64), n=n,
             metrics=metrics, label="fused",
         )
+        from dsort_tpu.obs.prof import LEDGER
+
+        LEDGER.drain_to(metrics)
         metrics.bump("device_handles")
         metrics.event("device_handle", n_keys=n, shards=1)
         return h
@@ -168,6 +179,9 @@ def fused_sort_small(
         out = np.asarray(
             _fused_small_fn(n_pad, str(data.dtype), kernel)(buf, np.int32(n))
         )
+    from dsort_tpu.obs.prof import LEDGER
+
+    LEDGER.drain_to(metrics)
     with timer.phase("assemble"):
         return out[:n]
 

@@ -20,31 +20,78 @@ operable telemetry surface, four pillars:
 - `obs.flight`: a bounded ring of recent events per scheduler that dumps a
   postmortem bundle (ring, config, mesh state, counters, the recovery
   path that fired) whenever any recovery path fires.
+
+PR 9 adds the *why slow* plane (ARCHITECTURE §9):
+
+- `obs.prof`: the compile/cost/HBM ledger — every jit build records
+  compile seconds, XLA cost analysis and memory analysis under the same
+  ladder-rung variant keys the serving cache uses (``variant_compiled``
+  events, ``dsort_variant_*`` gauges) — plus the ``--memwatch`` tap
+  snapshotting device memory at phase boundaries (``hbm_watermark``).
+- `obs.analyze`: the journal-native why-slow verdict behind ``dsort
+  report --analyze`` — phase waterfall with cross-process critical path,
+  straggler attribution, queue/compile/execute split, wire bytes, skew.
 """
 
+from dsort_tpu.obs.analyze import (  # noqa: F401
+    VERDICT_KEYS,
+    analyze_records,
+    format_analysis,
+)
 from dsort_tpu.obs.flight import (  # noqa: F401
     BUNDLE_SCHEMA_KEYS,
     RECOVERY_EVENTS,
     FlightRecorder,
 )
 from dsort_tpu.obs.histogram import LatencyHistogram  # noqa: F401
-from dsort_tpu.obs.merge import merge_journals, merge_records, read_journal  # noqa: F401
+from dsort_tpu.obs.merge import (  # noqa: F401
+    group_rotated,
+    merge_journals,
+    merge_records,
+    read_journal,
+    read_journal_set,
+    rotated_set,
+)
+from dsort_tpu.obs.prof import (  # noqa: F401
+    LEDGER,
+    LEDGER_EVENT_FIELDS,
+    CompileLedger,
+    MemWatch,
+    device_memory_snapshot,
+    instrument_jit,
+    ledger_from_journal,
+    variant_label,
+)
 from dsort_tpu.obs.server import MetricsServer  # noqa: F401
 from dsort_tpu.obs.slo import SLO_QUANTILES, SLO_STAGES, slo_from_journal  # noqa: F401
 from dsort_tpu.obs.telemetry import Telemetry, parse_prometheus_text  # noqa: F401
 
 __all__ = [
     "BUNDLE_SCHEMA_KEYS",
+    "CompileLedger",
     "FlightRecorder",
+    "LEDGER",
+    "LEDGER_EVENT_FIELDS",
     "LatencyHistogram",
+    "MemWatch",
     "MetricsServer",
     "RECOVERY_EVENTS",
     "SLO_QUANTILES",
     "SLO_STAGES",
     "Telemetry",
+    "VERDICT_KEYS",
+    "analyze_records",
+    "device_memory_snapshot",
+    "format_analysis",
+    "group_rotated",
+    "instrument_jit",
+    "ledger_from_journal",
     "merge_journals",
     "merge_records",
     "parse_prometheus_text",
     "read_journal",
+    "read_journal_set",
+    "rotated_set",
     "slo_from_journal",
+    "variant_label",
 ]

@@ -1,0 +1,332 @@
+"""Journal-native why-slow analysis: ``dsort report --analyze``.
+
+The journal already records everything a performance verdict needs — phase
+spans, job boundaries, queue waits, compile costs (`obs.prof`), skew
+reports (`parallel.exchange`), HBM watermarks, wire-byte counters.  This
+module replays any journal (single-process or a ``--merge``\\ d multi-host
+trace, `obs.merge`) into one structured verdict:
+
+- **phase waterfall + critical path**: per-(process, phase) wall seconds;
+  the *critical process* is the one whose last event gates completion of
+  the whole span, its largest phase is the *critical phase*, and the
+  critical path lists that process's phases by wall share — "which host
+  and which phase did the fleet wait on".
+- **straggler attribution**: with >= 2 sources, each process's busy time
+  (summed phase seconds) is scored against the fleet mean; the max score
+  names the straggler, and ``phase_excess_s`` says which phases it lost
+  the time in relative to its peers.
+- **queue-wait vs execute vs compile split**: queue waits from
+  ``job_dequeued`` (the serving layer's measured wait), compile seconds
+  from ``variant_compiled``, execute = phase wall minus compile (compiles
+  land inside the dispatching phase, so the subtraction attributes them).
+- **wire**: bytes the exchange put on the wire (final ``job_done``
+  counters) and — when the caller supplies a measured link bandwidth —
+  the seconds those bytes *should* have cost.
+- **skew**: the worst ``skew_report`` (max/mean bucket ratio + the
+  predicted overloaded device).
+- **hbm**: the high-water ``hbm_watermark`` and the phase it landed in.
+
+Every figure is derived from the records alone — the same replay
+discipline as `obs.slo`: analyzing a journal twice, or a scrape and a
+replay of the same session, must agree exactly.
+"""
+
+from __future__ import annotations
+
+from dsort_tpu.obs.prof import ledger_from_journal
+
+#: Top-level verdict keys (schema, test-enforced against ARCHITECTURE §9).
+VERDICT_KEYS = (
+    "span_s",
+    "sources",
+    "phases",
+    "dominant_phase",
+    "critical_src",
+    "critical_phase",
+    "critical_path",
+    "straggler",
+    "splits",
+    "wire",
+    "skew",
+    "hbm",
+    "jobs",
+    "slowest_job",
+    "compiles",
+)
+
+
+def _src_name(src: int) -> str:
+    return f"p{int(src)}"
+
+
+def analyze_records(
+    records: list[dict], link_bytes_per_s: float | None = None
+) -> dict:
+    """One journal (raw or merged) -> the why-slow verdict dict.
+
+    ``link_bytes_per_s`` (optional, e.g. from a transfer probe) prices the
+    wire bytes into expected seconds; without it the wire section carries
+    bytes only.
+    """
+    recs = sorted(
+        (r for r in records if isinstance(r.get("mono"), (int, float))),
+        key=lambda r: (r["mono"], r.get("seq", 0)),
+    )
+    if not recs:
+        return {k: None for k in VERDICT_KEYS}
+    t0 = recs[0]["mono"]
+    t1 = recs[-1]["mono"]
+    # Per-(src, phase) wall seconds; phase_end carries its own measured
+    # ``seconds`` (PhaseTimer), so no start/end pairing is needed and a
+    # torn journal missing a phase_start still attributes correctly.
+    phase_s: dict[tuple[int, str], float] = {}
+    src_end: dict[int, float] = {}
+    src_events: dict[int, int] = {}
+    waits: list[float] = []
+    jobs: dict[tuple[int, object], dict] = {}
+    counters_final: dict[tuple[int, object], dict] = {}
+    skew_best: dict | None = None
+    hbm_best: dict | None = None
+    for r in recs:
+        src = int(r.get("src", 0))
+        src_end[src] = r["mono"]
+        src_events[src] = src_events.get(src, 0) + 1
+        etype = r.get("type")
+        if etype == "phase_end":
+            sec = r.get("seconds")
+            if isinstance(sec, (int, float)):
+                key = (src, str(r.get("phase", "?")))
+                phase_s[key] = phase_s.get(key, 0.0) + float(sec)
+        elif etype == "job_dequeued":
+            w = r.get("wait_s")
+            if isinstance(w, (int, float)):
+                waits.append(float(w))
+        elif etype == "job_start":
+            key = (src, r.get("job"))
+            if key not in jobs:
+                jobs[key] = {
+                    "src": src,
+                    "job": r.get("job"),
+                    "tenant": r.get("tenant", "default"),
+                    "n_keys": r.get("n_keys"),
+                    "start": r["mono"],
+                    "duration_s": None,
+                }
+        elif etype in ("job_done", "job_failed"):
+            key = (src, r.get("job"))
+            st = jobs.get(key)
+            if st is not None and st["duration_s"] is None:
+                st["duration_s"] = round(r["mono"] - st["start"], 6)
+                st["outcome"] = "done" if etype == "job_done" else "failed"
+            c = r.get("counters")
+            if isinstance(c, dict):
+                counters_final[key] = c
+        elif etype == "skew_report":
+            ratio = r.get("max_mean_ratio", 0.0)
+            if skew_best is None or ratio > skew_best.get("max_mean_ratio", 0.0):
+                skew_best = {
+                    k: v for k, v in r.items()
+                    if k not in ("seq", "t", "mono", "type")
+                }
+        elif etype == "hbm_watermark":
+            b = r.get("bytes_in_use", 0)
+            if hbm_best is None or b > hbm_best.get("bytes_in_use", 0):
+                hbm_best = {
+                    "bytes_in_use": b,
+                    "max_device_bytes": r.get("max_device_bytes", 0),
+                    "phase": r.get("phase", "?"),
+                    "edge": r.get("edge", "?"),
+                    "src": src,
+                }
+    srcs = sorted(src_end)
+    # -- phase waterfall + critical path ------------------------------------
+    phase_totals: dict[str, float] = {}
+    for (src, phase), sec in phase_s.items():
+        phase_totals[phase] = phase_totals.get(phase, 0.0) + sec
+    dominant_phase = (
+        max(phase_totals, key=phase_totals.get) if phase_totals else None
+    )
+    critical_src = max(srcs, key=lambda s: src_end[s])
+    crit_phases = {
+        phase: sec for (src, phase), sec in phase_s.items()
+        if src == critical_src
+    }
+    critical_phase = (
+        max(crit_phases, key=crit_phases.get) if crit_phases else None
+    )
+    critical_path = [
+        {"src": critical_src, "name": _src_name(critical_src),
+         "phase": phase, "seconds": round(sec, 6)}
+        for phase, sec in sorted(
+            crit_phases.items(), key=lambda kv: -kv[1]
+        )
+    ]
+    # -- straggler attribution ----------------------------------------------
+    busy = {
+        s: sum(sec for (src, _), sec in phase_s.items() if src == s)
+        for s in srcs
+    }
+    straggler = None
+    if len(srcs) >= 2:
+        mean_busy = sum(busy.values()) / len(busy)
+        scores = {
+            s: (busy[s] / mean_busy if mean_busy > 0 else 1.0) for s in srcs
+        }
+        worst = max(scores, key=scores.get)
+        others = [s for s in srcs if s != worst]
+        excess = {}
+        for (src, phase), sec in phase_s.items():
+            if src != worst:
+                continue
+            peer = [phase_s.get((o, phase), 0.0) for o in others]
+            peer_mean = sum(peer) / len(peer) if peer else 0.0
+            if sec - peer_mean > 0:
+                excess[phase] = round(sec - peer_mean, 6)
+        straggler = {
+            "src": worst,
+            "name": _src_name(worst),
+            "score": round(scores[worst], 3),
+            "busy_s": round(busy[worst], 6),
+            "phase_excess_s": dict(
+                sorted(excess.items(), key=lambda kv: -kv[1])
+            ),
+        }
+    # -- splits: queue wait vs execute vs compile ---------------------------
+    ledger = ledger_from_journal(recs)
+    compile_s = round(sum(e["compile_s"] for e in ledger.values()), 6)
+    total_phase_s = round(sum(phase_totals.values()), 6)
+    splits = {
+        "queue_wait_s": round(sum(waits), 6),
+        "compile_s": compile_s,
+        "execute_s": round(max(total_phase_s - compile_s, 0.0), 6),
+        "phase_wall_s": total_phase_s,
+    }
+    # -- wire ---------------------------------------------------------------
+    bytes_on_wire = sum(
+        int(c.get("exchange_bytes_on_wire", 0))
+        for c in counters_final.values()
+    )
+    wire = {"bytes_on_wire": bytes_on_wire}
+    if link_bytes_per_s and bytes_on_wire:
+        wire["expected_transfer_s"] = round(
+            bytes_on_wire / float(link_bytes_per_s), 6
+        )
+    # -- assemble -----------------------------------------------------------
+    job_rows = [
+        {k: v for k, v in j.items() if k != "start"}
+        for j in jobs.values()
+    ]
+    finished = [j for j in job_rows if j.get("duration_s") is not None]
+    slowest_job = (
+        max(finished, key=lambda j: j["duration_s"]) if finished else None
+    )
+    return {
+        "span_s": round(t1 - t0, 6),
+        "sources": {
+            _src_name(s): {
+                "events": src_events[s],
+                "busy_s": round(busy[s], 6),
+                "end_s": round(src_end[s] - t0, 6),
+            }
+            for s in srcs
+        },
+        "phases": {
+            _src_name(src): {
+                phase: round(sec, 6)
+                for (s2, phase), sec in sorted(phase_s.items())
+                if s2 == src
+            }
+            for src in srcs
+        },
+        "dominant_phase": dominant_phase,
+        "critical_src": _src_name(critical_src),
+        "critical_phase": critical_phase,
+        "critical_path": critical_path,
+        "straggler": straggler,
+        "splits": splits,
+        "wire": wire,
+        "skew": skew_best,
+        "hbm": hbm_best,
+        "jobs": job_rows,
+        "slowest_job": slowest_job,
+        "compiles": ledger,
+    }
+
+
+def format_analysis(verdict: dict) -> str:
+    """The human table behind ``dsort report --analyze``."""
+    if not verdict or verdict.get("span_s") is None:
+        return "(empty journal: nothing to analyze)\n"
+    lines = [f"why-slow verdict over a {verdict['span_s'] * 1e3:.1f} ms span:"]
+    crit = verdict.get("critical_phase")
+    lines.append(
+        f"  critical path : {verdict['critical_src']}"
+        + (f" / {crit}" if crit else "")
+        + " gated completion"
+    )
+    if verdict.get("dominant_phase"):
+        lines.append(
+            f"  dominant phase: {verdict['dominant_phase']} "
+            f"({verdict['splits']['phase_wall_s'] * 1e3:.1f} ms phase wall "
+            "total)"
+        )
+    st = verdict.get("straggler")
+    if st:
+        worst = next(iter(st["phase_excess_s"]), None)
+        lines.append(
+            f"  straggler     : {st['name']} (busy {st['busy_s'] * 1e3:.1f} "
+            f"ms, {st['score']:.2f}x fleet mean"
+            + (f"; lost in {worst}" if worst else "")
+            + ")"
+        )
+    sp = verdict["splits"]
+    lines.append(
+        f"  split         : queue wait {sp['queue_wait_s'] * 1e3:.1f} ms | "
+        f"compile {sp['compile_s'] * 1e3:.1f} ms | "
+        f"execute {sp['execute_s'] * 1e3:.1f} ms"
+    )
+    wire = verdict.get("wire") or {}
+    if wire.get("bytes_on_wire"):
+        exp = wire.get("expected_transfer_s")
+        lines.append(
+            f"  wire          : {wire['bytes_on_wire']:,} bytes"
+            + (f" (~{exp * 1e3:.1f} ms at the probed link)" if exp else "")
+        )
+    skew = verdict.get("skew")
+    if skew:
+        lines.append(
+            f"  skew          : max/mean bucket ratio "
+            f"{skew.get('max_mean_ratio', 0):.2f}"
+            + (
+                f", heaviest receiver device {skew['recv_argmax']}"
+                if "recv_argmax" in skew else ""
+            )
+        )
+    hbm = verdict.get("hbm")
+    if hbm:
+        lines.append(
+            f"  hbm watermark : {hbm['bytes_in_use']:,} bytes in phase "
+            f"{hbm['phase']} ({hbm['edge']})"
+        )
+    sj = verdict.get("slowest_job")
+    if sj:
+        lines.append(
+            f"  slowest job   : job {sj.get('job')} "
+            f"(tenant {sj.get('tenant')}, {sj.get('n_keys')} keys, "
+            f"{(sj.get('duration_s') or 0) * 1e3:.1f} ms)"
+        )
+    lines.append("phase waterfall (per process):")
+    for name, phases in sorted((verdict.get("phases") or {}).items()):
+        for phase, sec in sorted(phases.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {name:<6} {phase:<16} {sec * 1e3:>12.3f} ms")
+    ledger = verdict.get("compiles") or {}
+    if ledger:
+        lines.append("compiled-variant ledger:")
+        for label, e in sorted(ledger.items()):
+            lines.append(
+                f"  {label:<52} x{e['compiles']}  "
+                f"{e['compile_s'] * 1e3:>10.1f} ms  "
+                f"{e['flops']:>14.3g} flops  "
+                f"{e['peak_hbm_bytes']:>12,} peak B"
+            )
+    return "\n".join(lines) + "\n"

@@ -24,6 +24,8 @@ diagnostic artifact and a postmortem must render whatever survived.
 from __future__ import annotations
 
 import json
+import os
+import re
 import statistics
 
 from dsort_tpu.utils.logging import get_logger
@@ -107,3 +109,80 @@ def merge_journals(paths: list[str]) -> tuple[list[dict], int]:
         journals.append(recs)
         skipped += s
     return merge_records(journals), skipped
+
+
+# -- rotated journal sets (--journal-rotate-mb) ------------------------------
+
+_ROTATED = re.compile(r"^(?P<base>.+)\.(?P<n>\d+)$")
+
+
+def rotation_base(path: str) -> str:
+    """The un-rotated journal path a piece belongs to (identity for the
+    base file itself)."""
+    m = _ROTATED.match(str(path))
+    return m.group("base") if m else str(path)
+
+
+def rotated_set(path: str) -> list[str]:
+    """One journal's rotated pieces in WRITE order: ``path.1`` (oldest),
+    ``path.2``, ..., then ``path`` itself (newest) — exactly the order
+    `EventLog.flush_jsonl` rotated them out, so concatenating the pieces
+    reconstructs the original append order."""
+    base = rotation_base(str(path))
+    pieces = []
+    d = os.path.dirname(base) or "."
+    name = os.path.basename(base)
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        entries = []
+    for e in entries:
+        m = _ROTATED.match(e)
+        if m and m.group("base") == name:
+            pieces.append((int(m.group("n")), os.path.join(d, e)))
+    out = [p for _, p in sorted(pieces)]
+    if os.path.exists(base) or not out:
+        out.append(base)
+    return out
+
+
+def read_journal_set(paths: list[str]) -> tuple[list[dict], int]:
+    """Read several files as ONE journal (a rotated set, concatenated in
+    the given order): ``(records, skipped_lines)``."""
+    records: list[dict] = []
+    skipped = 0
+    for p in paths:
+        recs, s = read_journal(str(p))
+        records.extend(recs)
+        skipped += s
+    return records, skipped
+
+
+def group_rotated(paths: list[str]) -> list[list[str]]:
+    """CLI args -> per-journal rotated sets, one group per logical journal.
+
+    Each given path expands to its on-disk rotated set; paths naming
+    pieces of the same journal (``a.jsonl.1 a.jsonl``) collapse into one
+    group, so ``dsort report --merge`` never mistakes a rotation for a
+    second process.  Group order follows first mention.
+
+    A ``.N``-suffixed arg is treated as a rotation piece ONLY when its
+    base journal is evident — also passed as an arg, or present on disk.
+    Independent journals that merely end in digits (``trace.0 trace.1``,
+    the per-rank naming some launchers use) each keep their own group, so
+    the multi-process merge is never silently collapsed.
+    """
+    argset = {str(p) for p in paths}
+    groups: dict[str, list[str]] = {}
+    for p in paths:
+        p = str(p)
+        m = _ROTATED.match(p)
+        if m and (m.group("base") in argset or os.path.isfile(m.group("base"))):
+            base = m.group("base")
+        else:
+            base = p
+        if base not in groups:
+            # A .N-named independent journal is its own single-file group
+            # (no sibling discovery — its trailing digits are not ours).
+            groups[base] = [base] if _ROTATED.match(base) else rotated_set(base)
+    return list(groups.values())

@@ -1,0 +1,395 @@
+"""Performance introspection: the compile/cost/HBM ledger + memwatch tap.
+
+The telemetry plane (PR 6) answers *what happened*; this module is the
+first half of *why slow* — every jitted program the tree builds records
+what its compile actually cost:
+
+- **`CompileLedger`**: a process-wide table keyed by the SAME ladder-rung
+  variant keys the compiled-variant cache uses (`serve.variants`), holding
+  compile seconds, XLA ``cost_analysis()`` flops / bytes-accessed, and
+  ``memory_analysis()`` argument / output / temp HBM bytes per variant.
+  Entries journal as ``variant_compiled`` events (drained into whichever
+  job's `Metrics` is live when the compile lands) and render as gauges on
+  ``/metrics`` (`obs.telemetry`) and as the ledger table in ``dsort top``.
+- **`instrument_jit`**: wraps a ``jax.jit`` callable so its first call per
+  specialization goes through the AOT path (``lower().compile()``) —
+  the compile is TIMED and introspected instead of vanishing inside the
+  first dispatch.  The compiled executable is cached per argument spec
+  (shapes / dtypes / shardings), so repeat calls pay one dict lookup; any
+  AOT failure falls back to the raw jit permanently for that spec (the
+  instrument must never be able to fail a sort).
+- **`MemWatch`**: an event tap (``--memwatch``) that snapshots device
+  memory at every phase boundary into ``hbm_watermark`` events —
+  ``memory_stats()`` where the backend provides it (TPU/GPU), the summed
+  ``jax.live_arrays()`` footprint elsewhere (the CPU mesh) — so the
+  analyzer (`obs.analyze`) can put an HBM waterline under the phase
+  waterfall.
+
+``peak_hbm_bytes`` is defined as ``argument + output + temp - alias``
+(aliased/donated outputs share their argument's buffer) — the upper bound
+of bytes live at once while the executable runs.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+from dsort_tpu.utils.logging import get_logger
+
+log = get_logger("obs.prof")
+
+#: Fields every ``variant_compiled`` event carries (schema, test-enforced
+#: against ARCHITECTURE §9 like the flight-recorder bundle keys).
+LEDGER_EVENT_FIELDS = (
+    "variant",
+    "compile_s",
+    "flops",
+    "bytes_accessed",
+    "peak_hbm_bytes",
+    "temp_hbm_bytes",
+    "output_hbm_bytes",
+    "argument_hbm_bytes",
+)
+
+#: (metric name, ledger field) of each ``/metrics`` gauge the ledger
+#: exports — THE one copy `obs.telemetry.render_prometheus` and the
+#: ``dsort top`` ledger table both render from.
+LEDGER_GAUGES = (
+    ("dsort_variant_compile_seconds", "compile_s"),
+    ("dsort_variant_compiles", "compiles"),
+    ("dsort_variant_flops", "flops"),
+    ("dsort_variant_peak_hbm_bytes", "peak_hbm_bytes"),
+)
+
+def _new_entry(label: str) -> dict:
+    return {
+        "variant": label,
+        "compiles": 0,
+        "compile_s": 0.0,
+        "flops": 0.0,
+        "bytes_accessed": 0.0,
+        "peak_hbm_bytes": 0,
+        "temp_hbm_bytes": 0,
+        "output_hbm_bytes": 0,
+        "argument_hbm_bytes": 0,
+    }
+
+
+def _fold(entry: dict, event: dict) -> None:
+    """Fold one ``variant_compiled`` event into an aggregate entry — the
+    ONE aggregation rule `CompileLedger.record` and `ledger_from_journal`
+    share (the scrape==journal parity contract rests on it).  Compile
+    seconds accumulate (the total price paid for the variant); cost/HBM
+    figures describe ONE executable, so re-compiles of the same variant
+    (per-placement specializations) take the max.
+    """
+    entry["compiles"] += 1
+    entry["compile_s"] = round(
+        entry["compile_s"] + float(event.get("compile_s", 0.0)), 6
+    )
+    for f in ("flops", "bytes_accessed"):
+        entry[f] = max(entry[f], float(event.get(f, 0.0)))
+    for f in ("peak_hbm_bytes", "temp_hbm_bytes", "output_hbm_bytes",
+              "argument_hbm_bytes"):
+        entry[f] = max(entry[f], int(event.get(f, 0)))
+
+
+def variant_label(key) -> str:
+    """The ledger's string form of a variant key tuple (journal/metrics
+    label): ``"fused|81920|int32|auto"``.
+
+    Nested tuples (the ring's per-step caps) flatten with ``-`` and any
+    character outside ``[A-Za-z0-9._|-]`` becomes ``_`` — the label rides
+    inside Prometheus label values, and the in-tree minimal parser splits
+    label bodies on commas, so the label must never contain one.
+    """
+    if isinstance(key, str):
+        return key
+
+    def part(p):
+        if isinstance(p, (tuple, list)):
+            return "-".join(part(q) for q in p)
+        return _SAFE.sub("_", str(p))
+
+    return "|".join(part(p) for p in key)
+
+
+_SAFE = re.compile(r"[^A-Za-z0-9._|-]")
+
+
+def _normalize_cost(cost) -> dict:
+    """``Compiled.cost_analysis()`` returns a dict or a one-dict list
+    depending on the jax version; normalize to flat floats."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    return {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+    }
+
+
+def _normalize_memory(mem) -> dict:
+    """``Compiled.memory_analysis()`` -> argument/output/temp/peak bytes
+    (zeros when the backend provides nothing)."""
+    arg = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+    out = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+    tmp = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+    alias = int(getattr(mem, "alias_size_in_bytes", 0) or 0)
+    return {
+        "argument_hbm_bytes": arg,
+        "output_hbm_bytes": out,
+        "temp_hbm_bytes": tmp,
+        "peak_hbm_bytes": max(arg + out + tmp - alias, 0),
+    }
+
+
+class CompileLedger:
+    """Process-wide ledger of jit compiles, keyed by variant label.
+
+    `record` aggregates per variant (a prewarm compiles the same rung once
+    per slice placement — compiles count up, compile seconds sum, HBM
+    figures take the max) and queues one ``variant_compiled`` event per
+    compile; `drain_to` journals the queued events through the first live
+    `Metrics` that comes by, so the ledger needs no plumbing of its own.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+        self._pending: list[dict] = []
+
+    def record(
+        self, key, compile_s: float, cost=None, mem=None
+    ) -> dict:
+        label = variant_label(key)
+        c = _normalize_cost(cost)
+        m = _normalize_memory(mem)
+        event = {
+            "variant": label,
+            "compile_s": round(float(compile_s), 6),
+            "flops": c.get("flops", 0.0),
+            "bytes_accessed": c.get("bytes_accessed", 0.0),
+            **m,
+        }
+        with self._lock:
+            e = self._entries.get(label)
+            if e is None:
+                e = self._entries[label] = _new_entry(label)
+            _fold(e, event)
+            self._pending.append(event)
+        return event
+
+    def drain_to(self, metrics) -> int:
+        """Journal queued compiles through ``metrics`` (no-op when the
+        metrics has neither a journal nor taps — the events would vanish
+        and must stay queued for a consumer that records)."""
+        if metrics is None or (metrics.journal is None and not metrics.taps):
+            return 0
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for ev in pending:
+            metrics.bump("variant_compiles")
+            metrics.event("variant_compiled", **ev)
+        return len(pending)
+
+    def snapshot(self) -> dict[str, dict]:
+        """Aggregated per-variant rows (the ``/metrics`` gauge source)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def reset(self) -> None:
+        """Drop all state (tests; a process serves one trajectory)."""
+        with self._lock:
+            self._entries.clear()
+            self._pending.clear()
+
+
+#: THE process-wide ledger every instrumented build records into.
+LEDGER = CompileLedger()
+
+
+def ledger_from_journal(records: list[dict]) -> dict[str, dict]:
+    """Replay ``variant_compiled`` events into the same aggregate shape as
+    `CompileLedger.snapshot` — the scrape==journal ground-truth side."""
+    out: dict[str, dict] = {}
+    for r in records:
+        if r.get("type") != "variant_compiled":
+            continue
+        label = str(r.get("variant", "?"))
+        e = out.get(label)
+        if e is None:
+            e = out[label] = _new_entry(label)
+        _fold(e, r)
+    return out
+
+
+# -- the instrumented jit boundary ------------------------------------------
+
+
+def _arg_spec(a):
+    """One argument's specialization signature: shape, dtype, placement.
+
+    Placement matters — jit specializes per sharding/device (the serve
+    prewarm compiles one executable per slice lead), so each placement is
+    its own compiled entry in the wrapper's cache.
+    """
+    shape = getattr(a, "shape", None)
+    if shape is None:
+        return ("static", repr(a))
+    sharding = getattr(a, "sharding", None)
+    return (
+        tuple(shape),
+        str(getattr(a, "dtype", "?")),
+        str(sharding) if sharding is not None else None,
+    )
+
+
+class LedgeredJit:
+    """A jit callable whose compiles are timed and introspected.
+
+    First call per argument spec: ``lower().compile()`` under a timer,
+    ``cost_analysis``/``memory_analysis`` recorded into the ledger under
+    ``key_fn(*args)``, the compiled executable cached.  Repeat calls are
+    one dict lookup.  Any AOT-path failure logs once and pins that spec to
+    the raw jit callable — instrumentation must never fail a sort.
+    """
+
+    def __init__(self, fn, key_fn, ledger: CompileLedger | None = None):
+        self._fn = fn
+        self._key_fn = key_fn
+        self._ledger = ledger if ledger is not None else LEDGER
+        self._lock = threading.Lock()
+        self._compiled: dict[tuple, object] = {}
+
+    def __call__(self, *args):
+        spec = tuple(_arg_spec(a) for a in args)
+        with self._lock:
+            target = self._compiled.get(spec)
+        if target is None:
+            target = self._compile(spec, args)
+        return target(*args)
+
+    def _compile(self, spec: tuple, args):
+        # Compile OUTSIDE the lock (seconds; two racing callers both
+        # compile and both record — jax dedupes the executable underneath,
+        # same doctrine as `serve.variants.VariantCache`).
+        try:
+            t0 = time.perf_counter()
+            compiled = self._fn.lower(*args).compile()
+            dt = time.perf_counter() - t0
+            cost = mem = None
+            try:
+                cost = compiled.cost_analysis()
+            except Exception:  # pragma: no cover - backend-dependent
+                pass
+            try:
+                mem = compiled.memory_analysis()
+            except Exception:  # pragma: no cover - backend-dependent
+                pass
+            self._ledger.record(self._key_fn(*args), dt, cost, mem)
+        except Exception as e:
+            log.warning(
+                "compile instrumentation unavailable (%s); running the "
+                "raw jit", (str(e).splitlines() or [repr(e)])[0][:120],
+            )
+            compiled = self._fn
+        with self._lock:
+            self._compiled.setdefault(spec, compiled)
+        return compiled
+
+
+def instrument_jit(fn, key_fn) -> LedgeredJit:
+    """Wrap a jitted callable so its compiles land in the process ledger.
+
+    ``key_fn(*call_args) -> tuple`` builds the variant key — static parts
+    (worker count, rung, kernel) plus call-time parts (the dtype the jit
+    would specialize on anyway).
+    """
+    return LedgeredJit(fn, key_fn, LEDGER)
+
+
+# -- memwatch: HBM watermarks at phase boundaries ---------------------------
+
+
+def device_memory_snapshot() -> dict:
+    """Bytes resident on the accelerators right now.
+
+    ``memory_stats()`` (bytes_in_use / peak_bytes_in_use) where the
+    backend provides it; the summed ``jax.live_arrays()`` footprint
+    otherwise (the CPU mesh — no peak there, but the waterline is real).
+    """
+    import jax
+
+    per_dev: dict = {}
+    peak = 0
+    source = "memory_stats"
+    for d in jax.local_devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # pragma: no cover - backend-dependent
+            stats = None
+        if not stats:
+            source = "live_arrays"
+            break
+        per_dev[d.id] = int(stats.get("bytes_in_use", 0))
+        peak = max(peak, int(stats.get("peak_bytes_in_use", 0)))
+    if source == "live_arrays":
+        per_dev = {}
+        for a in jax.live_arrays():
+            try:
+                for shard in a.addressable_shards:
+                    did = shard.data.devices().pop().id
+                    per_dev[did] = per_dev.get(did, 0) + shard.data.nbytes
+            except Exception:  # deleted/donated arrays mid-iteration
+                continue
+        peak = 0
+    total = sum(per_dev.values())
+    return {
+        "bytes_in_use": int(total),
+        "max_device_bytes": int(max(per_dev.values(), default=0)),
+        "peak_bytes": int(peak),
+        "devices": len(per_dev),
+        "source": source,
+    }
+
+
+class MemWatch:
+    """Event tap emitting ``hbm_watermark`` at every phase boundary.
+
+    Attach to a job's `Metrics` (``--memwatch``); every ``phase_start``/
+    ``phase_end`` triggers one snapshot.  The nested ``metrics.event``
+    re-enters the tap list with an ``hbm_watermark`` type this tap
+    ignores, so there is no recursion.
+    """
+
+    def __init__(self, snapshot_fn=None):
+        self._snapshot = snapshot_fn or device_memory_snapshot
+
+    def attach(self, metrics) -> None:
+        if self not in metrics.taps:
+            metrics.taps.append(self)
+
+    def observe(self, etype: str, fields: dict, mono: float, metrics) -> None:
+        if etype not in ("phase_start", "phase_end"):
+            return
+        try:
+            snap = self._snapshot()
+        except Exception as e:  # diagnostics must never fail the job
+            log.warning("memwatch snapshot failed: %s", e)
+            return
+        metrics.bump("hbm_watermarks")
+        metrics.event(
+            "hbm_watermark",
+            phase=fields.get("phase", "?"),
+            edge="start" if etype == "phase_start" else "end",
+            **snap,
+        )

@@ -98,8 +98,12 @@ class Telemetry:
 
     def snapshot(self) -> dict:
         """JSON-able full state (the ``/json`` endpoint + ``dsort top``)."""
+        from dsort_tpu.obs.prof import LEDGER
+
+        ledger = LEDGER.snapshot()
         with self._lock:
             return {
+                "variant_ledger": ledger,
                 "counters": dict(self._counters),
                 "phase_seconds": {
                     k: round(v, 6) for k, v in self._phase_s.items()
@@ -119,6 +123,9 @@ class Telemetry:
 
     def render_prometheus(self) -> str:
         """The Prometheus text exposition snapshot (scrape body)."""
+        from dsort_tpu.obs.prof import LEDGER
+
+        ledger = LEDGER.snapshot()
         with self._lock:
             counters = dict(self._counters)
             phases = dict(self._phase_s)
@@ -162,6 +169,23 @@ class Telemetry:
                     f'dsort_admissions_total{{tenant="{tenant}",'
                     f'reason="{reason}"}} {admissions[(tenant, reason)]}'
                 )
+        if ledger:
+            from dsort_tpu.obs.prof import LEDGER_GAUGES
+
+            # The compile/cost/HBM ledger (obs.prof): one row per compiled
+            # variant, same labels as the journal's variant_compiled
+            # events — scrape == journal replay is the test contract.
+            lines.append(
+                "# HELP dsort_variant_compile_seconds Cumulative jit "
+                "compile seconds per ladder-rung variant (obs.prof)."
+            )
+            for metric, field in LEDGER_GAUGES:
+                lines.append(f"# TYPE {metric} gauge")
+                for label in sorted(ledger):
+                    lines.append(
+                        f'{metric}{{variant="{label}"}} '
+                        f"{ledger[label][field]:.6g}"
+                    )
         lines.append("# TYPE dsort_jobs_in_flight gauge")
         lines.append(f"dsort_jobs_in_flight {in_flight}")
         for name in sorted(gauges):

@@ -50,6 +50,27 @@ def render_top(parsed: dict) -> str:
             f"misses {int(misses)}  prewarmed {prewarmed}  "
             f"hit rate {rate * 100:.1f}%"
         )
+    # Compile/cost/HBM ledger (obs.prof): one row per compiled variant.
+    from dsort_tpu.obs.prof import LEDGER_GAUGES
+
+    ledger: dict[str, dict] = {}
+    for metric, field in LEDGER_GAUGES:
+        for labels, value in _labeled(parsed, metric):
+            ledger.setdefault(labels.get("variant", "?"), {})[field] = value
+    if ledger:
+        lines.append("variant ledger:")
+        lines.append(
+            f"  {'variant':<50}{'compiles':>9}"
+            f"{'compile ms':>12}{'flops':>14}{'peak HBM':>14}"
+        )
+        for variant in sorted(ledger):
+            row = ledger[variant]
+            lines.append(
+                f"  {variant:<50}{int(row.get('compiles', 0)):>9}"
+                f"{row.get('compile_s', 0.0) * 1e3:>12.1f}"
+                f"{row.get('flops', 0.0):>14.3g}"
+                f"{int(row.get('peak_hbm_bytes', 0)):>14,}"
+            )
     jobs = _labeled(parsed, "dsort_jobs_total")
     if jobs:
         lines.append("jobs:")

@@ -68,6 +68,7 @@ __all__ = [
     "note_alltoall_attempt",
     "resolve_exchange",
     "check_ring_overflow",
+    "skew_stats",
 ]
 
 
@@ -196,6 +197,39 @@ def alltoall_wire_bytes(cap_pair: int, bytes_per_slot: int, num_workers: int) ->
     return int((num_workers - 1) * cap_pair * bytes_per_slot * num_workers)
 
 
+def skew_stats(hist: np.ndarray, num_workers: int) -> dict:
+    """Reduce the plan's measured ``(P, P)`` bucket histogram to the skew
+    signal the analyzer (`obs.analyze`) reads.
+
+    ``max_mean_ratio`` is the headline: the largest bucket over the mean
+    bucket — 1.0 on perfectly uniform data, growing with Zipf exponent.
+    ``send_load``/``recv_load`` are the per-device totals (keys each
+    source ships / each destination merges); their imbalance ratios
+    predict which device gates the exchange (``recv_argmax``) before it
+    runs.  A batched histogram (leading job axis) reduces element-wise
+    max over jobs, matching `step_maxes`' worst-case buffer view.
+    """
+    p = num_workers
+    m = np.asarray(hist).reshape(-1, p, p).max(axis=0).astype(np.int64)
+    mean = float(m.mean())
+    send = m.sum(axis=1)
+    recv = m.sum(axis=0)
+    return {
+        "max_bucket": int(m.max()),
+        "mean_bucket": round(mean, 2),
+        "max_mean_ratio": round(float(m.max()) / mean, 3) if mean > 0 else 1.0,
+        "send_load": [int(v) for v in send],
+        "recv_load": [int(v) for v in recv],
+        "send_imbalance": round(
+            float(send.max()) / max(float(send.mean()), 1e-9), 3
+        ) if send.size else 1.0,
+        "recv_imbalance": round(
+            float(recv.max()) / max(float(recv.mean()), 1e-9), 3
+        ) if recv.size else 1.0,
+        "recv_argmax": int(recv.argmax()) if recv.size else 0,
+    }
+
+
 def note_ring_plan(
     metrics, caps, hist, n_local: int, num_workers: int, bytes_per_slot: int,
     capacity_factor: float, jobs: int = 1,
@@ -228,6 +262,10 @@ def note_ring_plan(
     metrics.bump("exchange_ring_steps", (p - 1) * jobs)
     metrics.bump("exchange_bytes_on_wire", ring_b)
     metrics.bump("exchange_bytes_saved", max(padded_b - ring_b, 0))
+    # The histogram is already measured and host-resident: reducing it to
+    # the skew report costs one (P, P) numpy pass, so every ring plan
+    # journals its skew signal (obs.analyze reads it back).
+    metrics.event("skew_report", jobs=jobs, **skew_stats(hist, p))
     for k in range(1, p):
         metrics.event(
             "exchange_step", step=k, cap=int(caps[k]),

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dsort_tpu.config import JobConfig
 from dsort_tpu.data.partition import pad_kv_to_shards, pad_to_shards
+from dsort_tpu.obs.prof import LEDGER, instrument_jit
 from dsort_tpu.parallel.exchange import note_alltoall_attempt
 from dsort_tpu.ops.float_order import is_float_key_dtype, sort_float_keys_via_uint
 from dsort_tpu.ops.local_sort import sentinel_for, sort_keys, sort_padded
@@ -475,12 +476,25 @@ class SampleSort:
             and next(iter(self.mesh.devices.flat)).platform != "cpu"
             else ()
         )
-        return jax.jit(
-            shard_map(
-                fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
+        tag = "spmd" if kv_trailing is None else (
+            "spmd_kv2" if secondary else "spmd_kv"
+        )
+        # The introspection ledger's key mirrors `serve.variants.
+        # spmd_variant_key` (tag, P, n_local, cap, dtype, kernel, exchange)
+        # — the dtype joins at call time, exactly what the jit specializes
+        # on (obs.prof).
+        return instrument_jit(
+            jax.jit(
+                shard_map(
+                    fn, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ),
+                donate_argnums=donate,
             ),
-            donate_argnums=donate,
+            key_fn=lambda *a: (
+                tag, p, n_local, cap_pair, str(a[0].dtype),
+                self.job.local_kernel, "alltoall",
+            ),
         )
 
     def _cap_pair(self, n_local: int, factor: float) -> int:
@@ -523,12 +537,19 @@ class SampleSort:
             fn = functools.partial(_ring_plan_kv_shard, **kwargs)
             in_specs = (P(self.axis),) * 3
             out_specs = (P(self.axis), P(self.axis), P(), P())
-        return jax.jit(
-            shard_map(
-                fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
+        tag = "spmd_plan" if kv_trailing is None else "spmd_plan_kv"
+        return instrument_jit(
+            jax.jit(
+                shard_map(
+                    fn, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ),
+                donate_argnums=self._donate_keys(kv_trailing is not None),
             ),
-            donate_argnums=self._donate_keys(kv_trailing is not None),
+            key_fn=lambda *a: (
+                tag, self.num_workers, n_local, str(a[0].dtype),
+                self.job.local_kernel, "ring",
+            ),
         )
 
     @functools.lru_cache(maxsize=32)
@@ -566,12 +587,19 @@ class SampleSort:
         # it on the keys-only non-CPU path — without this the ring would
         # hold xs_sorted live next to the merged output, ~2x the all_to_all
         # path's peak HBM.
-        return jax.jit(
-            shard_map(
-                fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
+        tag = "spmd_ring" if kv_trailing is None else "spmd_ring_kv"
+        return instrument_jit(
+            jax.jit(
+                shard_map(
+                    fn, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ),
+                donate_argnums=self._donate_keys(kv_trailing is not None),
             ),
-            donate_argnums=self._donate_keys(kv_trailing is not None),
+            key_fn=lambda *a: (
+                tag, self.num_workers, n_local, caps, str(a[0].dtype),
+                self.job.local_kernel,
+            ),
         )
 
     def _dispatch_keys_ring(self, data: np.ndarray, timer, metrics: Metrics):
@@ -603,6 +631,7 @@ class SampleSort:
             # (P, P) int32 fetch — vs the padded path's worst case of a
             # full re-dispatch when a bucket overflows.
             hist_h = jax.device_get(hist)
+        LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
         note_ring_plan(
             metrics, caps, hist_h, n_local, p, data.dtype.itemsize,
@@ -616,6 +645,7 @@ class SampleSort:
             # One fetch = completion barrier + the invariant scalar (same
             # doctrine as the all_to_all path).
             c, ov = jax.device_get((out_counts, overflow))
+        LEDGER.drain_to(metrics)
         check_ring_overflow(ov)
         return merged, out_counts, c
 
@@ -640,6 +670,7 @@ class SampleSort:
         with timer.phase("spmd_sort"):
             ks, vsort, splitters, hist = planfn(xs, vs, cj)
             hist_h = jax.device_get(hist)
+        LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
         note_ring_plan(
             metrics, caps, hist_h, n_local, p, slot_bytes,
@@ -651,6 +682,7 @@ class SampleSort:
         with timer.phase("spmd_sort"):
             out_k, out_v, out_counts, overflow = ringfn(ks, vsort, cj, splitters)
             c, ov = jax.device_get((out_counts, overflow))
+        LEDGER.drain_to(metrics)
         check_ring_overflow(ov)
         return out_k, out_v, c
 
@@ -792,6 +824,7 @@ class SampleSort:
                 # calls were costing 2 extra trips per sort.
                 c, ov, ml = jax.device_get((out_counts, overflow, max_len))
             note_alltoall_attempt(metrics, cap_pair, data.dtype.itemsize, p)
+            LEDGER.drain_to(metrics)
             if not bool(ov.any()):
                 return merged, out_counts, c
             metrics.bump("capacity_retries")
@@ -953,6 +986,7 @@ class SampleSort:
                     # sort_ranges).
                     c, ov, ml = jax.device_get((out_counts, overflow, max_len))
                 note_alltoall_attempt(metrics, cap_pair, slot_bytes, p)
+                LEDGER.drain_to(metrics)
                 if not bool(ov.any()):
                     break
                 metrics.bump("capacity_retries")

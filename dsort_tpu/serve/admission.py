@@ -21,6 +21,9 @@ ADMISSION_REASONS = (
     "queue_full",      # global queue-depth limit reached (back off, retry)
     "tenant_limit",    # this tenant's in-flight limit reached (tenant backs off)
     "shutting_down",   # the service is draining; no new work is accepted
+    "slo_shed",        # --slo-shed-ms: this tenant's live p95 queue wait is
+                       # over target while work is queued — shed instead of
+                       # growing the wait (recovers once the queue drains)
 )
 
 
@@ -73,8 +76,16 @@ class AdmissionController:
     def tenant_inflight(self, tenant: str) -> int:
         return self._tenant_inflight.get(tenant, 0)
 
-    def consider(self, tenant: str, shutting_down: bool) -> Admission:
-        """The verdict for one submission; an admitted job is counted."""
+    def consider(
+        self, tenant: str, shutting_down: bool, shed: bool = False
+    ) -> Admission:
+        """The verdict for one submission; an admitted job is counted.
+
+        ``shed`` is the SLO-driven signal the service computes (live p95
+        queue wait over target with work still queued); it ranks below the
+        hard bounds — a full queue is still ``queue_full``, the more
+        actionable verdict for a backing-off client.
+        """
         depth = self.queue_depth
         t_depth = self.tenant_inflight(tenant)
         if shutting_down:
@@ -83,6 +94,8 @@ class AdmissionController:
             reason = "queue_full"
         elif t_depth >= self.max_tenant_inflight:
             reason = "tenant_limit"
+        elif shed:
+            reason = "slo_shed"
         else:
             reason = "admitted"
             self.queue_depth += 1

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -130,6 +131,12 @@ class SortService:
         )
         self.variants = VariantCache(self.serve.variant_cache_entries)
         self._inflight: dict = {}  # ticket -> allocated slice ids
+        # SLO-driven shedding (--slo-shed-ms): a sliding window of recent
+        # MEASURED queue waits per tenant.  A bounded deque — not the
+        # cumulative SLO histogram — so the signal decays: once the queue
+        # drains, new near-zero waits displace the congested ones and
+        # admission recovers (the drill the shed contract requires).
+        self._recent_waits: dict[str, deque] = {}
         if runner is None:
             import jax
 
@@ -226,8 +233,9 @@ class SortService:
         """
         data = np.asarray(data)
         tenant = tenant or self.job.tenant
+        shed = self._should_shed(tenant)
         with self._cv:
-            verdict = self._admission.consider(tenant, self._shutdown)
+            verdict = self._admission.consider(tenant, self._shutdown, shed)
         if self.telemetry is not None:
             self.telemetry.admission_verdict(tenant, verdict.reason)
         if not verdict.admitted:
@@ -327,11 +335,37 @@ class SortService:
                 )
                 continue
             wait_s = time.monotonic() - ticket.queued_mono
+            self._note_wait(tenant, wait_s)
             ticket.metrics.event(
                 "job_dequeued", tenant=tenant, wait_s=round(wait_s, 6),
                 big=big, slices=list(alloc),
             )
             self._pool.submit(self._execute, ticket, alloc, big)
+
+    # -- SLO-driven shedding (ROADMAP item 1 remainder) ---------------------
+
+    def _note_wait(self, tenant: str, wait_s: float) -> None:
+        dq = self._recent_waits.get(tenant)
+        if dq is None:
+            dq = self._recent_waits[tenant] = deque(maxlen=32)
+        dq.append(float(wait_s))
+
+    def _should_shed(self, tenant: str) -> bool:
+        """``--slo-shed-ms``: live p95 of this tenant's recent measured
+        queue waits over target WHILE work is queued.  The queued-work
+        gate is what makes the verdict self-healing: an empty queue means
+        a new job would wait ~0, so it is always admitted — and its
+        near-zero wait then washes the congested window out."""
+        target_ms = self.serve.slo_shed_ms
+        if not target_ms:
+            return False
+        with self._cv:
+            if self._admission.queue_depth <= 0:
+                return False
+        waits = list(self._recent_waits.get(tenant) or ())
+        if not waits:
+            return False
+        return float(np.percentile(waits, 95)) * 1e3 > target_ms
 
     # -- execution ----------------------------------------------------------
 
@@ -418,6 +452,9 @@ class SortService:
                     n_keys=n, tag=f"slice{sid}",
                     lane_key=("slice", devs[0].id),
                 )[:n]
+        from dsort_tpu.obs.prof import LEDGER
+
+        LEDGER.drain_to(m)
         m.bump("fused_small_jobs")
         m.event("job_done", n_keys=len(data), counters=dict(m.counters))
         self._publish_gauges()
@@ -608,6 +645,9 @@ class SortService:
                 np.asarray(fn(jax.device_put(zero, dev), np.int32(rung))[:1])
             if built:
                 fresh += 1
+        from dsort_tpu.obs.prof import LEDGER
+
+        LEDGER.drain_to(self._svc_metrics)
         if fresh:
             if self.telemetry is not None:
                 self.telemetry.inc_counter("variant_cache_prewarms", fresh)

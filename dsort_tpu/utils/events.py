@@ -95,6 +95,16 @@ EVENT_TYPES: dict[str, str] = {
                    "(reason, drain, queued, in_flight)",
     "serve_stop": "the service wound down; the journal's close event "
                   "(jobs_done, jobs_failed, counters)",
+    # Performance introspection plane (dsort_tpu.obs.prof/analyze, §9):
+    "variant_compiled": "one jit compile landed in the variant ledger "
+                        "(variant, compile_s, flops, bytes_accessed, "
+                        "peak/temp/output/argument_hbm_bytes)",
+    "skew_report": "the ring plan's measured bucket histogram, reduced "
+                   "(max_mean_ratio, send/recv device loads, predicted "
+                   "imbalance) — the skew signal the analyzer reads",
+    "hbm_watermark": "a --memwatch device-memory snapshot at a phase "
+                     "boundary (phase, edge, bytes_in_use, "
+                     "max_device_bytes, source)",
 }
 
 #: THE counter registry: every `Metrics.bump` name in the package, with its
@@ -147,6 +157,10 @@ COUNTERS: dict[str, str] = {
     "variant_cache_evictions": "compiled variants dropped by the LRU bound",
     "variant_cache_prewarms": "compiled-variant rungs built by the startup "
                               "prewarm pass",
+    "variant_compiles": "jit compiles recorded by the introspection ledger "
+                        "(obs.prof; each carries cost/HBM analysis)",
+    "hbm_watermarks": "device-memory snapshots taken at phase boundaries "
+                      "(--memwatch)",
 }
 
 
@@ -173,12 +187,22 @@ class Event:
 
 
 class EventLog:
-    """Thread-safe, append-only journal of typed events for one job/session."""
+    """Thread-safe, append-only journal of typed events for one job/session.
 
-    def __init__(self):
+    ``rotate_bytes`` (``--journal-rotate-mb``) bounds any one JSONL file: a
+    `flush_jsonl` that leaves ``path`` at or over the threshold atomically
+    renames it to ``path.N`` (N counting up — ``path.1`` is the oldest
+    piece) and the next flush starts a fresh ``path``, so a million-user
+    serve session can never grow one unbounded file.  ``dsort report``
+    stitches a rotated set back into one journal (`obs.merge.rotated_set`).
+    """
+
+    def __init__(self, rotate_bytes: int | None = None):
         self._lock = threading.Lock()
         self._events: list[Event] = []
         self._flushed = 0  # events already written by flush_jsonl
+        self._rotate_bytes = rotate_bytes
+        self._rotations = 0
 
     def emit(self, etype: str, **fields) -> Event:
         if etype not in EVENT_TYPES:
@@ -226,16 +250,55 @@ class EventLog:
         """Write only the events not yet flushed (truncating on the FIRST
         flush so a stale file never mixes sessions).  The per-job persist
         of long REPL sessions (`dsort serve/coordinator --journal`): IO per
-        job stays O(new events), not O(session)."""
+        job stays O(new events), not O(session).  With ``rotate_bytes``
+        set, a file left at/over the threshold rotates to ``path.N``
+        afterwards (see the class docstring)."""
         with self._lock:
             events = list(self._events)
             start = self._flushed
             self._flushed = len(events)
+        if start == 0:
+            # The anti-mixing guard covers the WHOLE rotated set: a stale
+            # session's path.N pieces would otherwise survive the base
+            # truncation and stitch into this session's trace when
+            # `dsort report` expands the set.
+            self._clear_rotated(path)
         if start == 0 or events[start:]:
             with open(path, "w" if start == 0 else "a",
                       encoding="utf-8") as f:
                 for e in events[start:]:
                     f.write(json.dumps(e.to_dict()) + "\n")
+        self._maybe_rotate(path)
+
+    def _clear_rotated(self, path: str) -> None:
+        if not self._rotate_bytes:
+            return
+        import os
+        import re
+
+        d = os.path.dirname(path) or "."
+        name = re.escape(os.path.basename(path))
+        try:
+            for entry in os.listdir(d):
+                if re.fullmatch(rf"{name}\.\d+", entry):
+                    os.remove(os.path.join(d, entry))
+        except OSError:  # diagnostics: never fatal
+            return
+
+    def _maybe_rotate(self, path: str) -> None:
+        if not self._rotate_bytes:
+            return
+        import os
+
+        try:
+            if os.path.getsize(path) < self._rotate_bytes:
+                return
+            with self._lock:
+                self._rotations += 1
+                n = self._rotations
+            os.replace(path, f"{path}.{n}")
+        except OSError:  # the journal is a diagnostic: never fatal
+            return
 
     @staticmethod
     def read_jsonl(path: str) -> list[dict]:

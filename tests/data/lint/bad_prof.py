@@ -1,0 +1,42 @@
+"""Fixture: introspection-plane discipline violations (DS201/DS202 + DS301).
+
+Models the compile ledger's two riskiest shapes: a ledger class whose
+entries/pending queues must stay lock-guarded with no blocking work under
+the lock (an AOT compile takes SECONDS — holding the ledger lock across it
+would serialize every concurrently-dispatching job), and an instrumented
+stage that must never record from inside the traced function (the record
+would run once, at compile time, and the "compile seconds" would be a
+trace-time constant).
+"""
+
+import threading
+import time
+
+import jax
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._pending = []
+
+    def record(self, ev):
+        with self._lock:
+            self._pending.append(ev)
+
+    def record_racy(self, ev):
+        self._pending.append(ev)  # DS201: guarded attribute, no lock held
+
+    def build_under_lock(self, fn, x):
+        with self._lock:
+            time.sleep(0.01)  # DS202: the compile stand-in, lock held
+            fn.wait()  # DS202: blocking on the build from under the lock
+
+
+@jax.jit
+def record_inside_trace(x, metrics):
+    metrics.event("variant_compiled", variant="fused|8|int32")  # DS301
+    t0 = time.perf_counter()  # DS301: the compile timer baked in at trace
+    print("compiled at", t0)  # DS301
+    return x + 1

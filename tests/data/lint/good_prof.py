@@ -1,0 +1,49 @@
+"""Fixture: near-miss clean twin of bad_prof — all discipline kept.
+
+The shapes `obs.prof` actually ships: lock held only for dict/list state,
+the compile and the journal emission both OUTSIDE the lock, and the
+timing/recording wrapped AROUND the jitted callable, never inside it.
+"""
+
+import threading
+import time
+
+import jax
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._pending = []
+
+    def record(self, ev):
+        with self._lock:
+            self._pending.append(ev)
+            self._entries[ev["variant"]] = ev
+
+    def drain_to(self, metrics):
+        with self._lock:  # swap the queue out under the lock ...
+            pending, self._pending = self._pending, []
+        for ev in pending:  # ... emit after it released: fine
+            metrics.event("variant_compiled", **ev)
+        return len(pending)
+
+    def build_outside_lock(self, fn, x):
+        compiled = fn.lower(x).compile()  # seconds — never under the lock
+        with self._lock:
+            self._entries.setdefault("spec", compiled)
+        return compiled
+
+
+@jax.jit
+def pure_stage(x):
+    return x + 1
+
+
+def record_around_trace(x, metrics):
+    t0 = time.perf_counter()  # host-side timer AROUND the traced call
+    y = pure_stage(x)
+    metrics.event("variant_compiled", variant="fused|8|int32",
+                  compile_s=time.perf_counter() - t0)
+    return y

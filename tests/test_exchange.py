@@ -292,6 +292,23 @@ def test_zipf_1m_padded_retries_ring_does_not(mesh8):
     )
     assert m_ring.counters["exchange_bytes_saved"] > 0
 
+    # ISSUE 9: every ring plan journals its skew signal (reduced from the
+    # histogram it already measured).  The zipf-1M run's max/mean bucket
+    # ratio must exceed a same-size uniform run's by a real margin — the
+    # analyzer's skew verdict rests on exactly this separation.
+    def skew_ratio(journal):
+        reports = [e for e in journal.events() if e.type == "skew_report"]
+        assert reports, "every ring plan must journal a skew_report"
+        return reports[-1].fields["max_mean_ratio"]
+
+    m_uni = _metered()
+    ss.sort(
+        gen_uniform(1 << 20, dtype=np.int64, seed=0),
+        metrics=m_uni, exchange="ring",
+    )
+    zipf_skew, uni_skew = skew_ratio(m_ring.journal), skew_ratio(m_uni.journal)
+    assert zipf_skew > 1.5 * uni_skew, (zipf_skew, uni_skew)
+
 
 # ---- fault contract -------------------------------------------------------
 

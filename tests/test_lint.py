@@ -80,6 +80,17 @@ def test_obs_fixture():
     assert run_fixture("good_obs.py") == []
 
 
+def test_prof_fixture():
+    """The introspection plane's discipline contract: ledger state stays
+    lock-guarded with the compile (seconds!) and the journal emission both
+    outside the lock, and nothing records from inside a traced function
+    (the 'compile timer' would become a trace-time constant)."""
+    diags = run_fixture("bad_prof.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS201": 1, "DS202": 2, "DS301": 3}
+    assert run_fixture("good_prof.py") == []
+
+
 def test_exceptions_checker_fixture():
     # Fixtures live outside the checker's recovery-path scope: rescope.
     scoped = [ExceptionsChecker(scope=("*.py",))]

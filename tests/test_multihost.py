@@ -384,6 +384,25 @@ def test_multihost_crash_drill_merged_trace_and_postmortem(tmp_path):
     assert ("acme", "admit_to_sorted") in truth
     assert truth[("acme", "admit_to_sorted")].count == 2  # one per process
 
+    # ISSUE 9: the analyzer replays the SAME real merged 2-process trace
+    # into a coherent why-slow verdict — the critical path names one of
+    # the two processes and a phase that actually ran there, and the
+    # per-source waterfall matches the journal's phase_end ground truth.
+    from dsort_tpu.obs import analyze_records
+
+    v = analyze_records(merged)
+    assert set(v["sources"]) == {"p0", "p1"}
+    assert v["critical_src"] in ("p0", "p1")
+    assert v["critical_phase"] in v["phases"][v["critical_src"]]
+    assert v["straggler"] is not None and v["straggler"]["name"] in ("p0", "p1")
+    phase_truth: dict = {}
+    for r in merged:
+        if r["type"] == "phase_end" and isinstance(r.get("seconds"), float):
+            key = (r["src"], r["phase"])
+            phase_truth[key] = phase_truth.get(key, 0.0) + r["seconds"]
+    for (src, phase), sec in phase_truth.items():
+        assert v["phases"][f"p{src}"][phase] == pytest.approx(sec)
+
     # The postmortem bundle names the resume path and its cost.
     bundles = FlightRecorder.read_bundles(str(flights))
     partial = [
