@@ -31,6 +31,11 @@ profile-smoke:  ## introspection-plane cost proof: ring sort with vs without jou
 	$(PY) -m dsort_tpu.cli bench --analyze-smoke --n 1048576 --reps 2 \
 	--journal /tmp/dsort_profile_smoke.jsonl
 
+external-smoke:  ## out-of-core wave pipeline: 8x-over-budget sort, overlap A/B + mid-wave fault drill (8-device cpu mesh)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m dsort_tpu.cli bench --external-wave --n 262144 --reps 1 \
+	--journal /tmp/dsort_external_smoke.jsonl
+
 # Regression diff over versioned bench artifacts (tolerance ladder:
 # ok >= 0.95 > noise >= 0.80 > regression >= 0.50 > severe); exits 1 on
 # severe (STRICT=1: also on regression).  Backend-free.
@@ -56,4 +61,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke serve-smoke profile-smoke bench-compare native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke serve-smoke profile-smoke external-smoke bench-compare native tsan asan ubsan sanitize

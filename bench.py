@@ -108,6 +108,12 @@ BENCH_SCHEMA_FIELD_TYPES = {
     "skew_ratio_uniform": "num",
     "hbm_watermark_bytes": "num",
     "introspection_ok": "bool",
+    # Out-of-core wave-pipeline rows (`dsort bench --external-wave`, ISSUE 10):
+    "over_hbm_factor": "num",
+    "num_waves": "num",
+    "overlap_speedup": "num",
+    "resume_fraction": "num",
+    "runs_resorted": "num",
 }
 
 _SCHEMA_TYPE_CHECKS = {
@@ -1110,6 +1116,46 @@ print(json.dumps({
     except Exception as e:  # the ladder must never sink the artifact
         _emit(
             "analyze_overhead_1M_8dev_cpu_mesh", 0.0, "frac",
+            baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
+
+    # Out-of-core wave-pipeline rows (ISSUE 10 / ROADMAP item 2): a binary
+    # key file 8x the per-wave device budget sorts through the mesh wave
+    # pipeline — overlap-on vs overlap-off A/B on the SAME data
+    # (`overlap_speedup`), bit-identical output, plus a mid-wave fault
+    # drill whose `resume_fraction` (re-sorted runs / total runs) must not
+    # exceed one wave's share.  The harness is `dsort bench
+    # --external-wave` — ONE copy of the contract, shared with `make
+    # external-smoke`.
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "dsort_tpu.cli", "bench",
+                "--external-wave", "--n", str(1 << 23), "--reps", "3",
+            ],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        rows = []
+        for ln in r.stdout.strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        for row in rows:
+            row["metric"] += "_8dev_cpu_mesh"
+            _emit_line(row)
+        if not rows:
+            raise RuntimeError(
+                f"external-wave emitted no rows (rc {r.returncode}): "
+                + (r.stderr.strip().splitlines() or ["no stderr"])[-1][:160]
+            )
+    except Exception as e:  # the ladder must never sink the artifact
+        _emit(
+            "external_wave_sort_uniform_8M_8dev_cpu_mesh", 0.0, "keys/sec",
             baseline=False,
             error=(str(e).splitlines() or [repr(e)])[0][:200],
         )

@@ -207,6 +207,110 @@ class ShardCheckpoint:
             except OSError:
                 pass
 
+    # -- auxiliary channels (tagged companion arrays) ------------------------
+    # A third namespace next to "shard_"/"range_": companion data a recovery
+    # path needs alongside a persisted range — the multi-host kv driver's
+    # sorted secondary keys ("sec"), its resume scratch ("rk"/"rv"/"rs"),
+    # and the wave pipeline's (wave, run) store below all live here.
+
+    def _aux_path(self, tag: str, idx: int) -> str:
+        return os.path.join(self.dir, f"aux_{tag}_{idx:05d}.npy")
+
+    def has_aux(self, tag: str, idx: int) -> bool:
+        return os.path.exists(self._aux_path(tag, idx))
+
+    def save_aux(self, tag: str, idx: int, arr: np.ndarray) -> None:
+        path = self._aux_path(tag, idx)
+        tmp = f"{path}.{self._token}.tmp.npy"
+        np.save(tmp, np.asarray(arr))
+        os.replace(tmp, path)
+        if self.journal is not None:
+            self.journal.emit(
+                "checkpoint_persist", kind=f"aux_{tag}", id=idx, n=len(arr)
+            )
+
+    def load_aux(self, tag: str, idx: int) -> np.ndarray:
+        return np.load(self._aux_path(tag, idx))
+
+    def load_aux_mmap(self, tag: str, idx: int) -> np.ndarray:
+        return np.load(self._aux_path(tag, idx), mmap_mode="r")
+
+    def completed_aux(self, tag: str) -> list[int]:
+        pre = f"aux_{tag}_"
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(pre) and name.endswith(".npy") and ".tmp" not in name:
+                out.append(int(name[len(pre):-len(".npy")]))
+        return sorted(out)
+
+    def clear_aux(self, tag: str) -> None:
+        for i in self.completed_aux(tag):
+            try:
+                os.remove(self._aux_path(tag, i))
+            except OSError:
+                pass
+
+    # -- wave runs: the (wave, run) granularity of the out-of-core wave
+    # pipeline (`models.wave_sort`, ARCHITECTURE §10).  Run ``r`` of wave
+    # ``w`` is device/range ``r``'s sorted slice of input wave ``w``; files
+    # are ``aux_wWWWWW_RRRRR.npy`` so an interrupted wave resumes by
+    # re-sorting ONLY its missing runs, never the job.
+
+    @staticmethod
+    def _wave_tag(wave: int) -> str:
+        return f"w{wave:05d}"
+
+    def has_wave_run(self, wave: int, run: int) -> bool:
+        return self.has_aux(self._wave_tag(wave), run)
+
+    def save_wave_run(self, wave: int, run: int, arr: np.ndarray) -> None:
+        path = self._aux_path(self._wave_tag(wave), run)
+        tmp = f"{path}.{self._token}.tmp.npy"
+        np.save(tmp, np.asarray(arr))
+        # The (wave, run) resume contract is a DURABILITY contract: a
+        # resume trusts completed_wave_runs(), so a run listed complete
+        # must survive an OS/host loss, not just a process kill — fsync
+        # before the rename makes the bytes durable (the wave pipeline
+        # hides this wait behind the next wave's device exchange).
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        if self.journal is not None:
+            self.journal.emit(
+                "checkpoint_persist", kind="wave_run", wave=wave, id=run,
+                n=len(arr),
+            )
+
+    def load_wave_run(self, wave: int, run: int) -> np.ndarray:
+        return self.load_aux(self._wave_tag(wave), run)
+
+    def load_wave_run_mmap(self, wave: int, run: int) -> np.ndarray:
+        return self.load_aux_mmap(self._wave_tag(wave), run)
+
+    def completed_wave_runs(self) -> list[tuple[int, int]]:
+        """All persisted ``(wave, run)`` pairs, sorted."""
+        out = []
+        for name in os.listdir(self.dir):
+            if (name.startswith("aux_w") and name.endswith(".npy")
+                    and ".tmp" not in name):
+                body = name[len("aux_w"):-len(".npy")]
+                w, _, r = body.partition("_")
+                if w.isdigit() and r.isdigit():
+                    out.append((int(w), int(r)))
+        return sorted(out)
+
+    def clear_wave_runs(self, wave: int | None = None) -> None:
+        """Drop wave runs — one wave's, or all of them."""
+        for w, r in self.completed_wave_runs():
+            if wave is None or w == wave:
+                try:
+                    os.remove(self._aux_path(self._wave_tag(w), r))
+                except OSError:
+                    pass
+
     def clear(self) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)
         os.makedirs(self.dir, exist_ok=True)

@@ -1153,6 +1153,138 @@ def _bench_serve_mixed(args, cfg: SortConfig) -> int:
     return 0 if ok else 1
 
 
+def _bench_external_wave(args, cfg: SortConfig) -> int:
+    """`dsort bench --external-wave`: the out-of-core wave pipeline bench.
+
+    The `make external-smoke` target and THE acceptance harness for
+    ROADMAP item 2 (ARCHITECTURE §10).  Sorts a binary key file ``W``
+    times larger than the per-wave device budget (``over_hbm_factor`` = W,
+    default 8) through the wave pipeline on the local mesh and emits JSON
+    rows:
+
+    - ``external_wave_sort_uniform_*``: keys/s with the overlap ON,
+      bit-identical to ``np.sort`` of the same data, plus the same-data
+      no-overlap A/B (``overlap_speedup`` = sequential / pipelined — the
+      measured value of overlapping wave k's exchange with wave k-1's
+      spill);
+    - ``external_wave_fault_drill_*``: the same job with a device loss
+      injected inside a middle wave's ring — repaired at run granularity
+      in flight; ``resume_fraction`` (re-sorted runs / total runs) must
+      stay ≤ 1/num_waves + one wave's slack, and the output is still
+      bit-identical.
+    """
+    import tempfile
+
+    import jax
+
+    from dsort_tpu.data.ingest import gen_uniform
+    from dsort_tpu.models.wave_sort import ExternalWaveSort
+    from dsort_tpu.parallel.mesh import local_device_mesh
+    from dsort_tpu.scheduler.fault import WorkerFailure
+
+    mesh = local_device_mesh(cfg.mesh.num_workers)
+    p = int(mesh.shape["w"])
+    if p < 2:
+        raise SystemExit(
+            "--external-wave needs a multi-device mesh (the wave exchange "
+            "is the pipeline under test); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    n = max(args.n, 1 << 14)
+    num_waves = 8  # the dataset is 8x the per-wave device budget
+    wave_elems = -(-n // num_waves)
+    journal = _open_journal(args)
+    rows: list[dict] = []
+
+    with tempfile.TemporaryDirectory() as td:
+        in_path = os.path.join(td, "in.bin")
+        data = gen_uniform(n, dtype=np.int32, seed=3)
+        data.tofile(in_path)
+        mm = np.memmap(in_path, dtype=np.int32, mode="r")
+        expect = np.sort(data)
+
+        def run(tag, overlap, fault_wave=None, reps=1):
+            # ONE sorter per mode: its compiled plan/ring programs persist
+            # across reps (instance-level caches), so min-of-reps times the
+            # pipeline, not the compiler.
+            s = ExternalWaveSort(
+                mesh, wave_elems=wave_elems,
+                spill_dir=os.path.join(td, "spill"),
+                job_id=f"bench_{tag}", resume=False, overlap=overlap,
+            )
+            if fault_wave is not None:
+                calls = {"n": 0}
+
+                def hook():
+                    calls["n"] += 1
+                    if calls["n"] == fault_wave + 1:
+                        raise WorkerFailure(
+                            "injected mid-ring device loss (bench drill)"
+                        )
+
+                s.fault_hook = hook
+            best, counters, all_ok = None, None, True
+            for _ in range(reps):
+                m = Metrics(journal=journal)
+                out = np.empty(n, np.int32)
+                t0 = time.perf_counter()
+                s.sort(mm, out=out, metrics=m)
+                dt = time.perf_counter() - t0
+                # EVERY rep must be bit-identical — a wrong fast rep must
+                # fail the row, not hide behind a correct slower one.
+                all_ok = all_ok and bool(np.array_equal(out, expect))
+                if best is None or dt < best:
+                    best = dt
+                counters = dict(m.counters)
+            return best, all_ok, counters
+
+        # Warm the shared-input page cache + one compile set off the clock.
+        run("warm", overlap=True)
+        dt_seq, ok_seq, _ = run("seq", overlap=False, reps=args.reps)
+        dt_pipe, ok_pipe, c_pipe = run("pipe", overlap=True, reps=args.reps)
+        total_runs = num_waves * p
+        rows.append({
+            "metric": f"external_wave_sort_uniform_{_nlabel(n)}",
+            "value": round(n / dt_pipe, 1),
+            "unit": "keys/sec",
+            "bit_identical": bool(ok_pipe and ok_seq),
+            "over_hbm_factor": num_waves,
+            "num_waves": num_waves,
+            "overlap_speedup": round(dt_seq / dt_pipe, 3),
+            "resume_fraction": 0.0,
+            "bytes_on_wire": int(c_pipe.get("exchange_bytes_on_wire", 0)),
+            "exchange": "ring",
+        })
+        dt_f, ok_f, c_f = run("fault", overlap=True, fault_wave=num_waves // 2)
+        resorted = int(c_f.get("wave_runs_resorted", 0))
+        frac = resorted / total_runs
+        rows.append({
+            "metric": f"external_wave_fault_drill_{_nlabel(n)}",
+            "value": round(n / dt_f, 1),
+            "unit": "keys/sec",
+            "bit_identical": bool(ok_f),
+            "over_hbm_factor": num_waves,
+            "num_waves": num_waves,
+            "runs_resorted": resorted,
+            "resume_fraction": round(frac, 4),
+            "exchange": "ring",
+        })
+        ok = (
+            ok_seq and ok_pipe and ok_f
+            and 0 < resorted
+            and frac <= 1.0 / num_waves + 1.0 / total_runs
+        )
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    if journal is not None:
+        journal.flush_jsonl(args.journal)
+    return 0 if ok else 1
+
+
+def _nlabel(n: int) -> str:
+    return f"{n >> 20}M" if n % (1 << 20) == 0 and n >= (1 << 20) else f"{n}_keys"
+
+
 def _bench_analyze_smoke(args, cfg: SortConfig) -> int:
     """`dsort bench --analyze-smoke`: the introspection plane's own cost.
 
@@ -1249,6 +1381,17 @@ def cmd_bench(args) -> int:
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if getattr(args, "external_wave", False):
+        if args.suite or getattr(args, "device_resident", False) or getattr(
+            args, "exchange_ab", False
+        ) or getattr(args, "serve_mixed", False) or getattr(
+            args, "analyze_smoke", False
+        ):
+            raise SystemExit(
+                "--external-wave is its own benchmark: run it as a "
+                "separate invocation"
+            )
+        return _bench_external_wave(args, _load_config(args))
     if getattr(args, "analyze_smoke", False):
         if args.suite or getattr(args, "device_resident", False) or getattr(
             args, "exchange_ab", False
@@ -1450,25 +1593,52 @@ def cmd_terasort(args) -> int:
     if args.external:
         from dsort_tpu.models.external_sort import ExternalTeraSort
 
-        if args.workers is not None:
-            log.warning(
-                "--workers has no effect with --external (run generation is "
-                "single-device; the merge parallelizes over host cores)"
-            )
-        s = ExternalTeraSort(
-            run_recs=args.run_recs,
-            spill_dir=args.spill_dir,
-            job_id=args.job_id,
-            resume=not args.no_resume,
-        )
-        metrics = Metrics()
+        mesh_n = getattr(args, "mesh", None)
+        if mesh_n is None and args.conf:
+            mesh_n = SortConfig.from_conf_file(args.conf).external.mesh
+        journal = _open_journal(args)
+        metrics = Metrics(journal=journal)
+        _maybe_memwatch(args, metrics)
         t0 = time.perf_counter()
-        s.sort_file(args.input, args.output or "terasort_out.bin", metrics=metrics)
+        try:
+            if mesh_n:
+                # Wave pipeline: mesh-parallel run generation, host spill/
+                # merge overlapping the next wave's device work.
+                from dsort_tpu.models.wave_sort import ExternalWaveTeraSort
+                from dsort_tpu.parallel.mesh import local_device_mesh
+
+                s = ExternalWaveTeraSort(
+                    mesh=local_device_mesh(mesh_n),
+                    wave_recs=args.run_recs,
+                    spill_dir=args.spill_dir,
+                    job_id=args.job_id,
+                    resume=not args.no_resume,
+                )
+            else:
+                if args.workers is not None:
+                    log.warning(
+                        "--workers needs the wave pipeline: pass --mesh N "
+                        "to make run generation mesh-parallel (without it, "
+                        "external run generation is single-device and only "
+                        "the merge parallelizes over host cores)"
+                    )
+                s = ExternalTeraSort(
+                    run_recs=args.run_recs,
+                    spill_dir=args.spill_dir,
+                    job_id=args.job_id,
+                    resume=not args.no_resume,
+                )
+            s.sort_file(
+                args.input, args.output or "terasort_out.bin", metrics=metrics
+            )
+        finally:
+            _write_journal(journal, args)
         dt = time.perf_counter() - t0
         n = os.path.getsize(args.input) // ExternalTeraSort.RECORD_BYTES
         log.info(
-            "terasort (external): %d records in %.1f ms (%.2f Mrec/s) | %s | "
-            "phases: %s",
+            "terasort (external%s): %d records in %.1f ms (%.2f Mrec/s) | %s"
+            " | phases: %s",
+            f", {mesh_n}-device waves" if mesh_n else "",
             n, dt * 1e3, n / dt / 1e6, dict(metrics.counters),
             metrics.summary()["phases_ms"],
         )
@@ -1492,25 +1662,72 @@ def cmd_terasort(args) -> int:
 
 
 def cmd_external(args) -> int:
-    """Out-of-core sort of a raw binary key file (runs + native merge)."""
-    from dsort_tpu.models.external_sort import ExternalSort
+    """Out-of-core sort of a raw binary key file.
 
-    s = ExternalSort(
-        run_elems=args.run_elems,
-        spill_dir=args.spill_dir,
-        job_id=args.job_id,
-        local_kernel=args.kernel or "auto",
-        resume=not args.no_resume,
+    Default: the single-device run/merge pipeline
+    (`models.external_sort.ExternalSort`).  With ``--mesh N`` (or conf
+    ``EXTERNAL_MESH``) the dataset runs through the WAVE pipeline
+    (`models.wave_sort.ExternalWaveSort`): device-budget-sized waves are
+    range-partitioned and ring-exchanged over the mesh while the previous
+    wave's runs spill on the host — datasets far larger than the mesh's
+    memory sort at device speed, resumable at (wave, run) granularity.
+    Flags override conf keys (``EXTERNAL_RUN_ELEMS`` /
+    ``EXTERNAL_WAVE_ELEMS`` / ``EXTERNAL_MESH``), same precedence as the
+    serving layer's ``SERVE_*``.
+    """
+    ext = (
+        SortConfig.from_conf_file(args.conf).external if args.conf
+        else SortConfig().external
     )
-    metrics = Metrics()
+    run_elems = args.run_elems if args.run_elems is not None else ext.run_elems
+    wave_elems = (
+        args.wave_elems if args.wave_elems is not None else ext.wave_elems
+    )
+    mesh_n = args.mesh if args.mesh is not None else ext.mesh
+    journal = _open_journal(args)
+    metrics = Metrics(journal=journal)
+    _maybe_memwatch(args, metrics)
     t0 = time.perf_counter()
-    s.sort_binary_file(args.input, args.output, dtype=np.dtype(args.dtype or "int32"),
-                       metrics=metrics)
+    try:
+        if mesh_n:
+            from dsort_tpu.models.wave_sort import ExternalWaveSort
+            from dsort_tpu.parallel.mesh import local_device_mesh
+
+            from dsort_tpu.config import JobConfig
+
+            s = ExternalWaveSort(
+                mesh=local_device_mesh(mesh_n),
+                wave_elems=wave_elems,
+                spill_dir=args.spill_dir,
+                job_id=args.job_id,
+                job=JobConfig(local_kernel=args.kernel) if args.kernel else None,
+                resume=not args.no_resume,
+                overlap=not getattr(args, "no_overlap", False),
+            )
+        else:
+            from dsort_tpu.models.external_sort import ExternalSort
+
+            s = ExternalSort(
+                run_elems=run_elems,
+                spill_dir=args.spill_dir,
+                job_id=args.job_id,
+                local_kernel=args.kernel or "auto",
+                resume=not args.no_resume,
+            )
+        s.sort_binary_file(
+            args.input, args.output, dtype=np.dtype(args.dtype or "int32"),
+            metrics=metrics,
+        )
+    finally:
+        # Journal parity with `dsort run`: the fault/resume timeline (wave
+        # events included) must land on disk even when the job fails.
+        _write_journal(journal, args)
     dt = time.perf_counter() - t0
     log.info(
-        "external-sorted %s -> %s in %.1f ms | %s | phases: %s",
-        args.input, args.output, dt * 1e3, dict(metrics.counters),
-        metrics.summary()["phases_ms"],
+        "external-sorted %s -> %s in %.1f ms%s | %s | phases: %s",
+        args.input, args.output, dt * 1e3,
+        f" ({mesh_n}-device waves)" if mesh_n else "",
+        dict(metrics.counters), metrics.summary()["phases_ms"],
     )
     return 0
 
@@ -1865,6 +2082,13 @@ def main(argv=None) -> int:
     p.add_argument("--memwatch", action="store_true",
                    help="snapshot device memory at phase boundaries into "
                         "hbm_watermark journal events")
+    p.add_argument("--external-wave", action="store_true",
+                   help="out-of-core wave-pipeline benchmark: sort a "
+                        "dataset 8x the per-wave device budget through the "
+                        "mesh wave pipeline (overlap-on vs overlap-off A/B "
+                        "+ a mid-wave fault drill with run-granular "
+                        "resume); JSON rows with over_hbm_factor and "
+                        "resume_fraction")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser(
@@ -1894,12 +2118,24 @@ def main(argv=None) -> int:
     p.add_argument("--workers", type=int, default=None)
     p.add_argument("--external", action="store_true",
                    help="out-of-core: spill sorted record runs, native merge")
+    p.add_argument("--mesh", type=int,
+                   help="external mode: run record waves over this many "
+                        "devices (the wave pipeline; conf EXTERNAL_MESH)")
     p.add_argument("--run-recs", type=int, default=1 << 20,
-                   help="records per spilled run (external mode)")
+                   help="records per spilled run / per wave (external mode)")
     p.add_argument("--spill-dir")
     p.add_argument("--job-id", default="tera_external")
     p.add_argument("--no-resume", action="store_true",
                    help="discard checkpointed runs and start fresh")
+    p.add_argument("--journal",
+                   help="write the job's structured event journal (JSONL) "
+                        "here; render with `dsort report`")
+    p.add_argument("--journal-rotate-mb", type=float,
+                   help="rotate the journal to PATH.N at this size")
+    p.add_argument("--memwatch", action="store_true",
+                   help="snapshot device memory at phase boundaries into "
+                        "hbm_watermark journal events")
+    p.add_argument("--conf", help="KEY=value conf file (EXTERNAL_* keys)")
     p.set_defaults(fn=cmd_terasort)
 
     p = sub.add_parser("external", help="out-of-core sort of a raw binary key file")
@@ -1907,11 +2143,33 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--output", required=True)
     p.add_argument("--dtype", default="int32")
     p.add_argument("--kernel", choices=["auto", "lax", "block", "bitonic", "pallas", "radix"])
-    p.add_argument("--run-elems", type=int, default=1 << 22)
+    p.add_argument("--run-elems", type=int, default=None,
+                   help="keys per spilled run, single-device mode (conf "
+                        "EXTERNAL_RUN_ELEMS; default %d)" % (1 << 22))
+    p.add_argument("--mesh", type=int,
+                   help="sort in mesh-parallel WAVES over this many devices "
+                        "(the wave pipeline, ARCHITECTURE §10; conf "
+                        "EXTERNAL_MESH)")
+    p.add_argument("--wave-elems", type=int, default=None,
+                   help="keys per wave — the per-wave device budget (conf "
+                        "EXTERNAL_WAVE_ELEMS; default %d)" % (1 << 22))
+    p.add_argument("--no-overlap", action="store_true",
+                   help="disable the wave pipeline's spill/exchange overlap "
+                        "(the A/B baseline)")
     p.add_argument("--spill-dir")
     p.add_argument("--job-id", default="external")
     p.add_argument("--no-resume", action="store_true",
                    help="discard checkpointed runs and start fresh")
+    p.add_argument("--journal",
+                   help="write the job's structured event journal (JSONL) "
+                        "here; render with `dsort report` (--analyze shows "
+                        "the wave waterfall)")
+    p.add_argument("--journal-rotate-mb", type=float,
+                   help="rotate the journal to PATH.N at this size")
+    p.add_argument("--memwatch", action="store_true",
+                   help="snapshot device memory at phase boundaries into "
+                        "hbm_watermark journal events")
+    p.add_argument("--conf", help="KEY=value conf file (EXTERNAL_* keys)")
     p.set_defaults(fn=cmd_external)
 
     p = sub.add_parser(

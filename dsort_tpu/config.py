@@ -280,12 +280,41 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ExternalConfig:
+    """Out-of-core sort knobs (`dsort external` / `dsort terasort
+    --external`; ARCHITECTURE §10).
+
+    ``run_elems`` sizes the single-device spill runs
+    (`models.external_sort`); ``wave_elems`` sizes the per-wave device
+    budget of the mesh wave pipeline (`models.wave_sort`); ``mesh`` is the
+    wave pipeline's worker count (None = single-device external sort).
+    Conf-file keys ``EXTERNAL_RUN_ELEMS`` / ``EXTERNAL_WAVE_ELEMS`` /
+    ``EXTERNAL_MESH`` follow the same conf/flag precedence as ``SERVE_*``.
+    """
+
+    run_elems: int = 1 << 22
+    wave_elems: int = 1 << 22
+    mesh: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.run_elems < 2:
+            raise ConfigError(f"run_elems must be >= 2, got {self.run_elems}")
+        if self.wave_elems < 2:
+            raise ConfigError(
+                f"wave_elems must be >= 2, got {self.wave_elems}"
+            )
+        if self.mesh is not None and self.mesh < 1:
+            raise ConfigError(f"mesh must be >= 1, got {self.mesh}")
+
+
+@dataclasses.dataclass(frozen=True)
 class SortConfig:
     """Top-level framework config: mesh + job + control-plane endpoints."""
 
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     job: JobConfig = dataclasses.field(default_factory=JobConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    external: ExternalConfig = dataclasses.field(default_factory=ExternalConfig)
     # Control-plane endpoint (native coordinator; reference server.conf parity).
     server_ip: str = "127.0.0.1"
     server_port: int = 9008        # reference default, server.conf:1
@@ -303,7 +332,9 @@ class SortConfig:
         (``SERVE_QUEUE_DEPTH``, ``SERVE_TENANT_INFLIGHT``,
         ``SERVE_SLICE_DEVICES``, ``SERVE_SMALL_JOB_MAX``,
         ``SERVE_WEIGHTS`` — ``tenant=weight,...`` — ``SERVE_PREWARM``,
-        and ``SERVE_SLO_SHED_MS``).
+        and ``SERVE_SLO_SHED_MS``) and out-of-core keys
+        (``EXTERNAL_RUN_ELEMS``, ``EXTERNAL_WAVE_ELEMS``,
+        ``EXTERNAL_MESH``).
         """
         def geti(key: str, default: int | None) -> int | None:
             return int(m[key]) if key in m else default
@@ -348,10 +379,16 @@ class SortConfig:
                 if "SERVE_SLO_SHED_MS" in m else None
             ),
         )
+        external = ExternalConfig(
+            run_elems=geti("EXTERNAL_RUN_ELEMS", ExternalConfig.run_elems),
+            wave_elems=geti("EXTERNAL_WAVE_ELEMS", ExternalConfig.wave_elems),
+            mesh=geti("EXTERNAL_MESH", None),
+        )
         return cls(
             mesh=mesh,
             job=job,
             serve=serve,
+            external=external,
             server_ip=m.get("SERVER_IP", "127.0.0.1"),
             server_port=int(m.get("SERVER_PORT", 9008)),
             output_path=m.get("OUTPUT_PATH", "output.txt"),

@@ -17,6 +17,11 @@ Runs are stored through `checkpoint.ShardCheckpoint` (atomic rename writes),
 so a killed job resumes by re-sorting only the missing runs — the SURVEY.md
 §5.4 upgrade over the reference's restart-the-chunk recovery, applied at
 out-of-core scale.
+
+This module is the SINGLE-DEVICE out-of-core path.  Its mesh-scale
+successor is `models.wave_sort` (ARCHITECTURE §10): the same spill/resume
+machinery composed with the SPMD ring exchange, one wave at a time —
+`dsort external --mesh N` selects it.
 """
 
 from __future__ import annotations

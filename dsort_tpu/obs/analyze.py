@@ -25,6 +25,12 @@ trace, `obs.merge`) into one structured verdict:
 - **skew**: the worst ``skew_report`` (max/mean bucket ratio + the
   predicted overloaded device).
 - **hbm**: the high-water ``hbm_watermark`` and the phase it landed in.
+- **waves**: out-of-core wave jobs (`models.wave_sort`) — per-wave spans
+  from ``wave_start``/``wave_done`` pairs, which wave GATED completion
+  (latest ``wave_done``), the slowest wave, and the run-granular resume
+  cost (``wave_resume`` missing-run totals).  The wave phases themselves
+  (``wave_read``/``wave_sort``/``wave_exchange``/``wave_spill``/``merge``)
+  land in the ordinary phase waterfall.
 
 Every figure is derived from the records alone — the same replay
 discipline as `obs.slo`: analyzing a journal twice, or a scrape and a
@@ -52,6 +58,7 @@ VERDICT_KEYS = (
     "jobs",
     "slowest_job",
     "compiles",
+    "waves",
 )
 
 
@@ -87,6 +94,10 @@ def analyze_records(
     counters_final: dict[tuple[int, object], dict] = {}
     skew_best: dict | None = None
     hbm_best: dict | None = None
+    wave_start: dict[tuple[int, object], float] = {}
+    wave_span: dict[tuple[int, object], float] = {}
+    wave_done_at: dict[tuple[int, object], float] = {}
+    wave_resumed = 0
     for r in recs:
         src = int(r.get("src", 0))
         src_end[src] = r["mono"]
@@ -128,6 +139,21 @@ def analyze_records(
                     k: v for k, v in r.items()
                     if k not in ("seq", "t", "mono", "type")
                 }
+        elif etype == "wave_start":
+            # Scoped by job ordinal: a session journal (the external-smoke
+            # bench, a serve loop) holds MANY wave jobs, and wave ids
+            # repeat per job — an unscoped key would pair one job's start
+            # with another's done.
+            wave_start.setdefault((src, r.get("job"), r.get("wave")), r["mono"])
+        elif etype == "wave_done":
+            key = (src, r.get("job"), r.get("wave"))
+            t_start = wave_start.get(key)
+            if t_start is not None:
+                wave_span[key] = round(r["mono"] - t_start, 6)
+            wave_done_at[key] = r["mono"]
+        elif etype == "wave_resume":
+            m = r.get("missing")
+            wave_resumed += int(m) if isinstance(m, (int, float)) else 0
         elif etype == "hbm_watermark":
             b = r.get("bytes_in_use", 0)
             if hbm_best is None or b > hbm_best.get("bytes_in_use", 0):
@@ -220,6 +246,29 @@ def analyze_records(
     slowest_job = (
         max(finished, key=lambda j: j["duration_s"]) if finished else None
     )
+    # -- waves: the out-of-core wave pipeline's verdict ---------------------
+    waves = None
+    if wave_done_at or wave_start or wave_resumed:
+        slowest_wave = None
+        if wave_span:
+            (s_src, s_job, s_wave), s_sec = max(
+                wave_span.items(), key=lambda kv: kv[1]
+            )
+            slowest_wave = {
+                "wave": s_wave, "seconds": s_sec, "src": s_src, "job": s_job,
+            }
+        gating = None
+        if wave_done_at:
+            (g_src, g_job, g_wave), _ = max(
+                wave_done_at.items(), key=lambda kv: kv[1]
+            )
+            gating = {"wave": g_wave, "src": g_src, "job": g_job}
+        waves = {
+            "count": len(set(wave_start) | set(wave_done_at)),
+            "resumed_runs": wave_resumed,
+            "slowest": slowest_wave,
+            "gating": gating,
+        }
     return {
         "span_s": round(t1 - t0, 6),
         "sources": {
@@ -250,6 +299,7 @@ def analyze_records(
         "jobs": job_rows,
         "slowest_job": slowest_job,
         "compiles": ledger,
+        "waves": waves,
     }
 
 
@@ -308,6 +358,21 @@ def format_analysis(verdict: dict) -> str:
             f"  hbm watermark : {hbm['bytes_in_use']:,} bytes in phase "
             f"{hbm['phase']} ({hbm['edge']})"
         )
+    wv = verdict.get("waves")
+    if wv:
+        slow = wv.get("slowest") or {}
+        gate = wv.get("gating") or {}
+        bits = [f"{wv.get('count', 0)} waves"]
+        if gate:
+            bits.append(f"wave {gate.get('wave')} gated completion")
+        if slow:
+            bits.append(
+                f"slowest wave {slow.get('wave')} "
+                f"({(slow.get('seconds') or 0) * 1e3:.1f} ms)"
+            )
+        if wv.get("resumed_runs"):
+            bits.append(f"{wv['resumed_runs']} runs re-sorted on resume")
+        lines.append("  waves         : " + ", ".join(bits))
     sj = verdict.get("slowest_job")
     if sj:
         lines.append(

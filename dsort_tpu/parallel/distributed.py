@@ -511,6 +511,8 @@ def _mh_stale_clear(ckpt, valid: bool, pid: int, job_id: str) -> bool:
         )
         ckpt.clear_ranges()
         ckpt.clear_shards()
+        for tag in ("sec", "rk", "rv", "rs"):  # kv aux channels (sorted
+            ckpt.clear_aux(tag)                # secondary + resume scratch)
     _mh_sync("dsort-mh-stale-clear")
     return True
 
@@ -711,11 +713,15 @@ def sort_local_records(
     identical calls.
 
     With ``job.checkpoint_dir`` + ``job_id`` the job persists per-host
-    (keys range, payload block) pairs behind the same partition-independent
-    fingerprint as `sort_local_shards`; a restart restores a COMPLETE
-    checkpoint (all hosts' pairs present).  A partial kv checkpoint clears
-    and re-sorts — record-level value reconstruction is a keys-only
-    capability for now (documented in ARCHITECTURE 'multi-host').
+    (keys range, payload block[, sorted secondary]) sets behind the same
+    partition-independent fingerprint as `sort_local_shards`; a restart
+    restores a COMPLETE checkpoint (all hosts' sets present).  A PARTIAL
+    kv checkpoint (host died mid-persist) resumes at RECORD granularity
+    (`_mh_resume_missing_kv`): the persisted sets already hold keys AND
+    payloads, so the missing records are reconstructed as the
+    (key, payload-row) multiset difference — the same row-hashing family
+    as `_global_fingerprint` — re-sorted over the current mesh, and
+    merge-sliced against the persisted ranges exactly like the keys path.
     """
     import numpy as np
 
@@ -746,9 +752,10 @@ def sort_local_records(
             keys, payload, secondary, job, axis_name, metrics, job_id
         )
     else:
-        out = _sort_local_records_plain(
+        k, v, _, off = _sort_local_records_plain(
             keys, payload, secondary, job, axis_name, metrics
         )
+        out = (k, v, off)
     metrics.event(
         "job_done", n_keys=len(out[0]), counters=dict(metrics.counters)
     )
@@ -758,7 +765,14 @@ def sort_local_records(
 def _sort_local_records_plain(
     keys, payload, secondary, job, axis_name, metrics
 ):
-    """The non-checkpointed pod-wide record sort core."""
+    """The non-checkpointed pod-wide record sort core.
+
+    Returns ``(local_k, local_v, local_s, offset)`` — ``local_s`` is this
+    host's slice of the SORTED secondary keys (None when the job has no
+    secondary); the checkpoint path persists it so a partial resume can
+    merge present and reconstructed records in full ``(key, secondary)``
+    order.
+    """
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -797,8 +811,11 @@ def _sort_local_records_plain(
         )
         with timer.phase("spmd_sort"):
             if secondary is not None:
-                out_k, _, out_v, out_counts, overflow, max_len = fn(xs, sj, vs, cj)
+                out_k, out_s, out_v, out_counts, overflow, max_len = fn(
+                    xs, sj, vs, cj
+                )
             else:
+                out_s = None
                 out_k, out_v, out_counts, overflow, max_len = fn(xs, vs, cj)
             ok = not bool(any_overflow(overflow))
         if ok:
@@ -815,10 +832,13 @@ def _sort_local_records_plain(
         raise RuntimeError("sample sort bucket overflow after max retries")
 
     with timer.phase("assemble"):
-        (local_k, local_v), offset = _per_host_egress(
-            out_counts, [(out_k, ()), (out_v, sv.shape[2:])]
-        )
-    return local_k, local_v, offset
+        arrays = [(out_k, ()), (out_v, sv.shape[2:])]
+        if out_s is not None:
+            arrays.append((out_s, ()))
+        parts, offset = _per_host_egress(out_counts, arrays)
+        local_k, local_v = parts[0], parts[1]
+        local_s = parts[2] if out_s is not None else None
+    return local_k, local_v, local_s, offset
 
 
 def _sort_local_records_ckpt(
@@ -884,28 +904,298 @@ def _sort_local_records_ckpt(
                 start,
             )
         if done or any(ckpt.has(i) for i in range(n_ranges)):
-            # Partial kv checkpoints re-sort: record-level value
-            # reconstruction is keys-only for now (see docstring).
-            metrics.event("checkpoint_clear", reason="partial kv checkpoint")
-            if pid == 0:
-                log.warning(
-                    "multihost kv checkpoint for %r is partial; re-sorting",
-                    job_id,
-                )
-                ckpt.clear_ranges()
-                ckpt.clear_shards()
-            _mh_sync("dsort-mh-kv-partial-clear")
-    out_k, out_v, off = _sort_local_records_plain(
+            # Partial kv checkpoint: record-level value reconstruction —
+            # restore the surviving (keys, payload[, secondary]) sets and
+            # re-sort ONLY the missing record multiset (VERDICT r5 #2).
+            return _mh_resume_missing_kv(
+                keys, payload, secondary, job, axis_name, metrics, job_id,
+                ckpt, man, done, fp, total,
+            )
+    out_k, out_v, off = _mh_kv_sort_and_persist(
+        keys, payload, secondary, job, axis_name, metrics, ckpt, fp, total,
+    )
+    return out_k, out_v, off
+
+
+def _mh_kv_sort_and_persist(
+    keys, payload, secondary, job, axis_name, metrics, ckpt, fp, total
+):
+    """Fresh pod-wide record sort + crash-ordered persist (manifest first,
+    then each host's range/payload[/secondary] set)."""
+    pid, nprocs = jax.process_index(), jax.process_count()
+    out_k, out_v, out_s, off = _sort_local_records_plain(
         keys, payload, secondary, job, axis_name, metrics
     )
     if pid == 0:
         ckpt.write_manifest(
             nprocs, keys.dtype, total, fingerprint=fp, n_ranges=nprocs,
-            kind="mh_kv",
+            kind="mh_kv", has_sec=out_s is not None,
         )
     _mh_sync("dsort-mh-kv-manifest")  # no pair may land before the manifest
     if os.environ.get("DSORT_MH_DIE_BEFORE_RANGE") == str(pid):
         os._exit(17)  # crash drill parity with the keys path
     ckpt.save_range(pid, out_k)
     ckpt.save(pid, out_v)
+    if out_s is not None:
+        # The sorted secondary rides its own aux channel: a partial resume
+        # needs it to merge present and reconstructed records in full
+        # (key, secondary) order, and to tell records apart whose payloads
+        # differ only in the secondary bytes.
+        ckpt.save_aux("sec", pid, out_s)
     return out_k, out_v, off
+
+
+def _row_hashes(payload_rows, sec_rows=None) -> "np.ndarray":
+    """Per-record FNV-1a identity over the raw payload (+secondary) bytes —
+    the same hash family as `models.validate._multiset`
+    (`_global_fingerprint`'s row hashing), kept per row instead of summed,
+    so record multisets can be differenced."""
+    import numpy as np
+
+    rows = np.ascontiguousarray(payload_rows)
+    n = len(rows)
+    if n == 0:
+        return np.zeros(0, np.uint64)
+    rb = rows.view(np.uint8).reshape(n, -1)
+    if sec_rows is not None:
+        sb = np.ascontiguousarray(sec_rows).view(np.uint8).reshape(n, -1)
+        rb = np.concatenate([rb, sb], axis=1)
+    with np.errstate(over="ignore"):
+        h = np.full(n, np.uint64(1469598103934665603))
+        prime = np.uint64(1099511628211)
+        for b in range(rb.shape[1]):
+            h = (h ^ rb[:, b].astype(np.uint64)) * prime
+    return h
+
+
+def _merge_split_kv(ak, asec, bk, bsec, k: int) -> tuple[int, int]:
+    """`_merge_split` under the composite ``(key, secondary)`` order
+    (plain key order when ``asec`` is None), ties to the ``a`` side.  All
+    inputs may be mmap-backed `_CatParts`; O(log) element reads."""
+    def gt(xk, xs, yk, ys):  # (xk, xs) > (yk, ys), lexicographic
+        if xk != yk:
+            return bool(xk > yk)
+        if xs is None:
+            return False
+        return bool(xs > ys)
+
+    lo, hi = max(0, k - len(bk)), min(k, len(ak))
+    while lo < hi:
+        i = (lo + hi) // 2
+        j = k - i
+        if j > 0 and gt(
+            bk[j - 1], bsec[j - 1] if bsec is not None else None,
+            ak[i], asec[i] if asec is not None else None,
+        ):
+            lo = i + 1
+        else:
+            hi = i
+    return lo, k - lo
+
+
+def _merge_slice_kv(a, b, start: int, stop: int):
+    """Rows [start, stop) of the composite-ordered merge of two sorted
+    record sequences ``a``/``b`` = ``(keys, secondary|None, payload)``
+    without materializing the merge.  The window order is
+    ``(key, secondary, a-side-first)`` — consistent with the bisection's
+    tie rule, so per-process windows concatenate into one globally sorted
+    sequence."""
+    import numpy as np
+
+    ak, asec, av = a
+    bk, bsec, bv = b
+    i0, j0 = _merge_split_kv(ak, asec, bk, bsec, start)
+    i1, j1 = _merge_split_kv(ak, asec, bk, bsec, stop)
+    wk = np.concatenate([ak[i0:i1], bk[j0:j1]])
+    wv = np.concatenate([av[i0:i1], bv[j0:j1]])
+    side = np.concatenate(
+        [np.zeros(i1 - i0, np.int8), np.ones(j1 - j0, np.int8)]
+    )
+    if asec is not None:
+        ws = np.concatenate([asec[i0:i1], bsec[j0:j1]])
+        order = np.lexsort((side, ws, wk))
+        return wk[order], wv[order], ws[order]
+    order = np.lexsort((side, wk))
+    return wk[order], wv[order], None
+
+
+def _mh_resume_missing_kv(
+    keys, payload, secondary, job, axis_name, metrics, job_id, ckpt, man,
+    done, fp, total,
+):
+    """Record-level partial-checkpoint resume (the kv twin of
+    `_mh_resume_missing`, VERDICT r5 #2).
+
+    A persisted host set is USABLE when its keys range, payload block and
+    (for secondary jobs) sorted-secondary channel all survived.  Records
+    whose key falls strictly inside a usable range's [min, max] are
+    accounted for (equal keys group contiguously in the global order, so
+    the whole group lives in that range); for boundary keys the missing
+    copies are reconstructed as the RECORD multiset difference — per
+    (boundary key, payload-row hash) the allgathered input counts minus
+    the persisted counts, split deterministically in process order — so
+    the union over hosts is exactly the missing record multiset whatever
+    the current input→host partition is.  The missing subset re-sorts over
+    the CURRENT mesh; each host then extracts its chunk of the composite
+    (key, secondary) merge of persisted and reconstructed records via rank
+    bisection, and the result re-persists under the current topology.
+    """
+    import numpy as np
+
+    pid, nprocs = jax.process_index(), jax.process_count()
+    has_sec = secondary is not None
+    sec = np.asarray(secondary) if has_sec else None
+    usable = [
+        i for i in sorted(done)
+        if ckpt.has(i) and (not has_sec or ckpt.has_aux("sec", i))
+    ]
+    present_k = [ckpt.load_range_mmap(i) for i in usable]
+    present_v = [ckpt.load_mmap(i) for i in usable]
+    present_s = (
+        [ckpt.load_aux_mmap("sec", i) for i in usable] if has_sec else None
+    )
+    nonempty = [ix for ix, r in enumerate(present_k) if len(r)]
+    in_present = np.zeros(len(keys), bool)
+    bset: set = set()
+    for ix in nonempty:
+        r = present_k[ix]
+        lo, hi = r[0], r[-1]
+        in_present |= (keys > lo) & (keys < hi)
+        bset.update((lo.item(), hi.item()))
+    bvals = np.asarray(sorted(bset), dtype=keys.dtype)
+    is_boundary = np.isin(keys, bvals)
+    base_idx = np.nonzero(~in_present & ~is_boundary)[0]
+    # -- boundary records: (key, row-hash) multiset difference --------------
+    tables = []  # per bval: (local_indices, local_hashes, uniq, counts)
+    for v in bvals:
+        li = np.nonzero(keys == v)[0]
+        lh = _row_hashes(payload[li], sec[li] if has_sec else None)
+        uh, uc = np.unique(lh, return_counts=True)
+        tables.append((li, lh, uh, uc))
+    take_idx: list = []
+    nb = len(bvals)
+    if nb:
+        lens = _allgather_u64([len(t[2]) for t in tables]).astype(np.int64)
+        max_l = int(lens.max())
+        if max_l:
+            flat = np.zeros((nb, 2, max_l), np.uint64)
+            for bi, (_, _, uh, uc) in enumerate(tables):
+                flat[bi, 0, : len(uh)] = uh
+                flat[bi, 1, : len(uh)] = uc.astype(np.uint64)
+            g = _allgather_u64(flat.reshape(-1)).reshape(
+                nprocs, nb, 2, max_l
+            )
+            for bi, (li, lh, _, _) in enumerate(tables):
+                v = bvals[bi]
+                # Persisted copies of v, hashed with the SAME identity.
+                pc: dict = {}
+                for ix in nonempty:
+                    rk = present_k[ix]
+                    a = int(np.searchsorted(rk, v, side="left"))
+                    b = int(np.searchsorted(rk, v, side="right"))
+                    if b > a:
+                        ph = _row_hashes(
+                            present_v[ix][a:b],
+                            present_s[ix][a:b] if has_sec else None,
+                        )
+                        for h, c in zip(*np.unique(ph, return_counts=True)):
+                            pc[int(h)] = pc.get(int(h), 0) + int(c)
+                vocab = sorted(
+                    {
+                        int(h)
+                        for proc in range(nprocs)
+                        for h in g[proc, bi, 0, : int(lens[proc, bi])]
+                    }
+                )
+                for h in vocab:
+                    counts = np.asarray(
+                        [
+                            int(
+                                g[proc, bi, 1][
+                                    g[proc, bi, 0, : int(lens[proc, bi])]
+                                    == np.uint64(h)
+                                ].sum()
+                            )
+                            for proc in range(nprocs)
+                        ],
+                        np.int64,
+                    )
+                    missing = int(counts.sum()) - pc.get(h, 0)
+                    if missing <= 0:
+                        continue
+                    prior = int(counts[:pid].sum())
+                    take = int(
+                        np.clip(missing - prior, 0, int(counts[pid]))
+                    )
+                    if take > 0:
+                        take_idx.extend(
+                            li[lh == np.uint64(h)][:take].tolist()
+                        )
+    sub_idx = np.concatenate(
+        [base_idx, np.asarray(sorted(take_idx), np.int64)]
+    ).astype(np.int64)
+    sub_k = keys[sub_idx]
+    sub_v = payload[sub_idx]
+    sub_s = sec[sub_idx] if has_sec else None
+    metrics.bump("multihost_ranges_restored", len(usable))
+    metrics.bump("multihost_resort_keys", len(sub_idx))
+    metrics.event(
+        "checkpoint_restore", kind="multihost_kv_partial", n=len(usable),
+        resort_keys=len(sub_idx),
+    )
+    log.warning(
+        "multihost kv resume of %r: %d/%d host sets restored; re-sorting "
+        "%d local records", job_id, len(usable), int(man["n_ranges"]),
+        len(sub_idx),
+    )
+    out_k, out_v, out_s, _ = _sort_local_records_plain(
+        sub_k, sub_v, sub_s, job, axis_name, metrics
+    )
+    # Publish each host's sorted missing slice through dedicated aux
+    # channels (disjoint from the persisted sets) so every host can
+    # bisect the full picture.
+    ckpt.save_aux("rk", pid, out_k)
+    ckpt.save_aux("rv", pid, out_v)
+    if has_sec:
+        ckpt.save_aux("rs", pid, out_s)
+    _mh_sync("dsort-mh-kv-missing-saved")
+    a = (
+        _CatParts(present_k),
+        _CatParts(present_s) if has_sec else None,
+        _CatParts(present_v),
+    )
+    b_k = _CatParts([ckpt.load_aux_mmap("rk", i) for i in range(nprocs)])
+    b_v = _CatParts([ckpt.load_aux_mmap("rv", i) for i in range(nprocs)])
+    b_s = (
+        _CatParts([ckpt.load_aux_mmap("rs", i) for i in range(nprocs)])
+        if has_sec else None
+    )
+    if len(a[0]) + len(b_k) != total:  # reconstruction must be lossless
+        raise RuntimeError(
+            f"multihost kv resume reconstructed {len(a[0]) + len(b_k)} of "
+            f"{total} records; clear the checkpoint and re-run"
+        )
+    start, stop = _chunk_bounds(total)
+    if len(a[0]):
+        out_k, out_v, out_s = _merge_slice_kv(a, (b_k, b_s, b_v), start, stop)
+    else:  # nothing usable survived: the reconstruction IS the output
+        out_k, out_v = b_k[start:stop], b_v[start:stop]
+        out_s = b_s[start:stop] if has_sec else None
+    # Re-persist under the CURRENT topology (next run full-restores); the
+    # scratch channels go too.  Barrier discipline matches the keys path.
+    _mh_sync("dsort-mh-kv-merged")
+    if pid == 0:
+        ckpt.clear_ranges()
+        ckpt.clear_shards()
+        for tag in ("sec", "rk", "rv", "rs"):
+            ckpt.clear_aux(tag)
+        ckpt.write_manifest(
+            nprocs, keys.dtype, total, fingerprint=fp, n_ranges=nprocs,
+            kind="mh_kv", has_sec=has_sec,
+        )
+    _mh_sync("dsort-mh-kv-rewrite")
+    ckpt.save_range(pid, out_k)
+    ckpt.save(pid, out_v)
+    if has_sec:
+        ckpt.save_aux("sec", pid, out_s)
+    return out_k, out_v, start

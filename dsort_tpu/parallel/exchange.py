@@ -423,6 +423,26 @@ def _ring_plan_shard(xs, count, *, num_workers, oversample, axis, kernel="lax"):
     return xs, splitters, hist
 
 
+def _wave_plan_shard(xs, count, splitters, *, num_workers, axis, kernel="lax"):
+    """Wave plan phase: local sort + FIXED-splitter lengths exchange.
+
+    The out-of-core wave pipeline (`models.wave_sort`) samples its
+    splitters ONCE up front so every wave's buckets land on stable owner
+    devices; each wave then needs only the local sort and the cheap
+    ``(P, P)`` histogram all_gather — the measured-capacity plan of
+    `_ring_plan_shard` minus the per-job splitter selection.  Returns
+    ``(xs_sorted, hist)``; the sorted shard stays device-resident for
+    `_ring_exchange_shard`, which takes the same replicated splitters.
+    """
+    from dsort_tpu.ops.local_sort import sort_padded
+
+    count = count[0]
+    xs, _ = sort_padded(xs, count, kernel)
+    _, lens = _bucket_bounds(xs, count, splitters)
+    hist = jax.lax.all_gather(lens, axis)  # (P, P) replicated
+    return xs, hist
+
+
 def _ring_plan_kv_shard(
     keys, payload, count, *, num_workers, oversample, axis, kernel="lax"
 ):

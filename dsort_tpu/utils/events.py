@@ -105,6 +105,14 @@ EVENT_TYPES: dict[str, str] = {
     "hbm_watermark": "a --memwatch device-memory snapshot at a phase "
                      "boundary (phase, edge, bytes_in_use, "
                      "max_device_bytes, source)",
+    # Out-of-core wave pipeline (models.wave_sort, ARCHITECTURE §10):
+    "wave_start": "one input wave entered the mesh pipeline "
+                  "(wave, n_keys)",
+    "wave_done": "a wave's runs all landed in the (wave, run) store "
+                 "(wave, runs, n_keys)",
+    "wave_resume": "an interrupted wave's missing runs were re-sorted at "
+                   "run granularity — restart-resume or in-flight repair "
+                   "(wave, missing, present, reason)",
 }
 
 #: THE counter registry: every `Metrics.bump` name in the package, with its
@@ -161,6 +169,10 @@ COUNTERS: dict[str, str] = {
                         "(obs.prof; each carries cost/HBM analysis)",
     "hbm_watermarks": "device-memory snapshots taken at phase boundaries "
                       "(--memwatch)",
+    "waves_sorted": "input waves run through the mesh exchange pipeline",
+    "wave_runs_resorted": "(wave, run) store entries re-sorted by the "
+                          "run-granular resume/repair path",
+    "wave_resort_keys": "keys re-sorted by the wave resume/repair path",
 }
 
 

@@ -478,10 +478,12 @@ def test_multihost_kv_checkpoint_restore(tmp_path):
 
 
 def test_multihost_kv_partial_checkpoint_resorts(tmp_path):
-    """A kv job losing a host mid-persist leaves a PARTIAL pair set; the
-    re-run must clear it and re-sort (record-level value reconstruction is
-    keys-only for now — ARCHITECTURE 'multi-host recovery'), still
-    producing the exact output with no restore counter."""
+    """A kv job losing a host mid-persist leaves a PARTIAL set; the re-run
+    restores the surviving (keys, payload, secondary) host set and
+    re-sorts ONLY the missing RECORDS — the record-level value
+    reconstruction of VERDICT r5 #2 (the (key, payload-row) multiset
+    difference), with ``multihost_resort_keys`` well below the total —
+    still producing the exact output."""
     from dsort_tpu.data.ingest import gen_terasort, terasort_secondary
 
     ck = tmp_path / "ck"
@@ -515,7 +517,12 @@ def test_multihost_kv_partial_checkpoint_resorts(tmp_path):
     np.testing.assert_array_equal(got_v, all_v[order])
     metas = [json.load(open(r2 / f"meta_{i}.json")) for i in range(2)]
     for meta in metas:
-        assert "multihost_ranges_restored" not in meta["counters"]
+        # The surviving host set restores; only the dead host's records
+        # (plus boundary-key copies) re-sort — NOT the whole job.
+        c = meta["counters"]
+        assert c.get("multihost_ranges_restored") == 1
+        assert 0 < c.get("multihost_resort_keys", 0) <= 0.75 * len(all_k)
+        assert "checkpoint_restore" in meta["events"]
 
     # And the re-persisted state from run 2 restores fully on a third run.
     r3 = tmp_path / "run3"
