@@ -16,10 +16,16 @@ bench-smoke:  ## device-resident sort + on-device validate on the 8-device cpu m
 	$(PY) -m dsort_tpu.cli bench --device-resident --n 200000 --reps 2 \
 	--journal /tmp/dsort_bench_smoke.jsonl
 
-bench-exchange-smoke:  ## ring-vs-alltoall exchange A/B (uniform + zipf) on the 8-device cpu mesh
+bench-exchange-smoke:  ## three-way alltoall/ring/fused exchange A/B (uniform + zipf + kv) on the 8-device cpu mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m dsort_tpu.cli bench --exchange-ab --n 200000 --reps 2 \
 	--journal /tmp/dsort_bench_exchange_smoke.jsonl
+
+# The fused-ring smoke is the same one-copy A/B harness — the fused arm
+# rides --exchange-ab so the three schedules always measure the same data.
+bench-fused-smoke: bench-exchange-smoke  ## fused Pallas ring kernel A/B smoke (alias of bench-exchange-smoke)
+
+fused-smoke: bench-fused-smoke  ## alias: ISSUE 11 CI name for the fused-ring smoke
 
 serve-smoke:  ## mixed small/large two-tenant workload through the real serving queue (8-device cpu mesh)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
@@ -61,4 +67,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke serve-smoke profile-smoke external-smoke bench-compare native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke profile-smoke external-smoke bench-compare native tsan asan ubsan sanitize

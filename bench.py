@@ -114,6 +114,12 @@ BENCH_SCHEMA_FIELD_TYPES = {
     "overlap_speedup": "num",
     "resume_fraction": "num",
     "runs_resorted": "num",
+    # Fused-ring A/B rows (`dsort bench --exchange-ab` fused arm, ISSUE 11):
+    "dispatches_per_exchange": "num",
+    "dispatches_per_exchange_ring": "num",
+    "ring_keys_per_sec": "num",
+    "speedup_vs_ring": "num",
+    "fused_launches_per_sort": "num",
 }
 
 _SCHEMA_TYPE_CHECKS = {
@@ -994,16 +1000,19 @@ print(json.dumps({
             error=(str(e).splitlines() or [repr(e)])[0][:200],
         )
 
-    # Ring-vs-alltoall exchange ladder (ISSUE 4): the adaptive ppermute
-    # schedule against the one-shot padded collective, on the 8-device cpu
-    # mesh (the schedules are the same program on a single chip — the mesh
-    # is where an exchange exists to compare).  The harness is `dsort
-    # bench --exchange-ab` — ONE copy of the A/B contract, shared with
-    # `make bench-exchange-smoke` — re-emitted here with the cpu-mesh
-    # suffix; rows: uniform int32 1M, zipf int64 1M (the capacity-retry
-    # workload), TeraSort kv records, each carrying per-sort
-    # `bytes_on_wire` for both schedules (every attempt charged: an
-    # overflowed padded dispatch pays for the shipment it then re-did).
+    # Exchange ladder (ISSUE 4, grown three-way by ISSUE 11): the adaptive
+    # ppermute ring and the FUSED Pallas ring kernel against the one-shot
+    # padded collective, on the 8-device cpu mesh (the schedules are the
+    # same program on a single chip — the mesh is where an exchange exists
+    # to compare).  The harness is `dsort bench --exchange-ab` — ONE copy
+    # of the A/B contract, shared with `make bench-exchange-smoke` /
+    # `make bench-fused-smoke` — re-emitted here with the cpu-mesh suffix;
+    # rows: uniform int32 1M, zipf int64 1M (the capacity-retry workload),
+    # TeraSort kv records, each carrying per-sort `bytes_on_wire` for the
+    # lax schedules (every attempt charged: an overflowed padded dispatch
+    # pays for the shipment it then re-did) plus an
+    # `exchange_fused_vs_ring_*` row whose structural axis is
+    # `dispatches_per_exchange` (lax ring P-1 -> fused 1).
     try:
         r = subprocess.run(
             [

@@ -878,23 +878,30 @@ def _bench_device_resident(args, cfg: SortConfig) -> int:
 
 
 def _bench_exchange_ab(args, cfg: SortConfig) -> int:
-    """`dsort bench --exchange-ab`: ring-vs-alltoall A/B on the local mesh.
+    """`dsort bench --exchange-ab`: the three-way exchange A/B on the local
+    mesh — lax all_to_all vs lax ring vs the FUSED Pallas ring kernel.
 
-    The `make bench-exchange-smoke` target (tier-1-gated like bench-smoke),
-    and THE ring-vs-alltoall harness — bench.py's cpu-mesh ladder shells
-    out to this command so the A/B contract lives in one place: for a
-    uniform int32, a zipf-skewed int64, and a TeraSort kv workload, sorts
-    the same data through both exchange schedules, asserts the outputs
-    bit-identical, and emits one JSON line per workload with both
-    throughputs and the measured per-sort ``bytes_on_wire`` of each
-    schedule (from the ``exchange_bytes_on_wire`` counter, which charges
-    every attempt — an overflowed padded dispatch pays for its failed
-    shipment too).
+    The `make bench-exchange-smoke` / `make bench-fused-smoke` targets
+    (tier-1-gated like bench-smoke), and THE exchange harness — bench.py's
+    cpu-mesh ladder shells out to this command so the A/B contract lives in
+    one place: for a uniform int32, a zipf-skewed int64, and a TeraSort kv
+    workload, sorts the same data through every schedule, asserts the
+    outputs bit-identical, and emits per workload (a) the unchanged
+    ring-vs-alltoall row with both throughputs and the measured per-sort
+    ``bytes_on_wire`` of each schedule (the counter charges every attempt —
+    an overflowed padded dispatch pays for its failed shipment too), and
+    (b) a ``exchange_fused_vs_ring_*`` row whose structural axis is
+    ``dispatches_per_exchange``: the lax ring issues P-1 ppermute
+    collectives per exchange, the fused kernel exactly ONE launch
+    (`ops.ring_kernel`).  On the CPU mesh the fused end-to-end figure is a
+    dispatch-overhead comparison only — the comm/compute overlap the kernel
+    exists for needs real ICI.
     """
     import jax
 
     from dsort_tpu.config import JobConfig
     from dsort_tpu.data.ingest import gen_terasort, gen_uniform, gen_zipf
+    from dsort_tpu.parallel.exchange import dispatches_per_exchange
     from dsort_tpu.parallel.mesh import local_device_mesh
     from dsort_tpu.parallel.sample_sort import SampleSort
 
@@ -961,7 +968,7 @@ def _bench_exchange_ab(args, cfg: SortConfig) -> int:
                 return k, k[order].tobytes() + v[order].tobytes()
 
             results, stats = {}, {}
-            for exch in ("alltoall", "ring"):
+            for exch in ("alltoall", "ring", "fused"):
                 run(exch)  # warm/compile
                 times = []
                 m = Metrics(journal=journal)
@@ -981,23 +988,27 @@ def _bench_exchange_ab(args, cfg: SortConfig) -> int:
                     // args.reps,
                     "saved": m.counters.get("exchange_bytes_saved", 0)
                     // args.reps,
+                    "launches": m.counters.get("fused_exchange_launches", 0)
+                    // args.reps,
                 }
-            if payload is None:
-                identical = bool(
-                    np.array_equal(results["alltoall"], results["ring"])
-                )
-            else:
-                identical = bool(
-                    np.array_equal(
-                        results["alltoall"][0], results["ring"][0]
-                    )
-                ) and results["alltoall"][1] == results["ring"][1]
-            ok_all = ok_all and identical
+
+            def same(a, b):
+                if payload is None:
+                    return bool(np.array_equal(results[a], results[b]))
+                return bool(
+                    np.array_equal(results[a][0], results[b][0])
+                ) and results[a][1] == results[b][1]
+
+            identical = same("alltoall", "ring")
+            fused_identical = same("ring", "fused")
+            ok_all = ok_all and identical and fused_identical
             n = len(keys)
+            p = mesh.shape["w"]
+            unit = "keys/sec" if payload is None else "rec/sec"
             print(json.dumps({
                 "metric": f"exchange_ring_vs_alltoall_{label}",
                 "value": round(n / stats["ring"]["dt"], 1),
-                "unit": "keys/sec" if payload is None else "rec/sec",
+                "unit": unit,
                 "alltoall_keys_per_sec": round(
                     n / stats["alltoall"]["dt"], 1
                 ),
@@ -1010,6 +1021,25 @@ def _bench_exchange_ab(args, cfg: SortConfig) -> int:
                 "capacity_retries_alltoall": stats["alltoall"]["retries"],
                 "capacity_retries_ring": stats["ring"]["retries"],
                 "bit_identical": identical,
+            }), flush=True)
+            print(json.dumps({
+                "metric": f"exchange_fused_vs_ring_{label}",
+                "value": round(n / stats["fused"]["dt"], 1),
+                "unit": unit,
+                "ring_keys_per_sec": round(n / stats["ring"]["dt"], 1),
+                "speedup_vs_ring": round(
+                    stats["ring"]["dt"] / stats["fused"]["dt"], 2
+                ),
+                "dispatches_per_exchange": dispatches_per_exchange(
+                    "fused", p
+                ),
+                "dispatches_per_exchange_ring": dispatches_per_exchange(
+                    "ring", p
+                ),
+                "fused_launches_per_sort": stats["fused"]["launches"],
+                "bytes_on_wire": stats["fused"]["bytes"],
+                "capacity_retries": stats["fused"]["retries"],
+                "bit_identical": fused_identical,
             }), flush=True)
     finally:
         _write_journal(journal, args)
@@ -1703,10 +1733,17 @@ def cmd_external(args) -> int:
                 job=JobConfig(local_kernel=args.kernel) if args.kernel else None,
                 resume=not args.no_resume,
                 overlap=not getattr(args, "no_overlap", False),
+                exchange=getattr(args, "exchange", None),
             )
         else:
             from dsort_tpu.models.external_sort import ExternalSort
 
+            if getattr(args, "exchange", None):
+                log.warning(
+                    "--exchange has no effect without --mesh: the "
+                    "single-device external sort has no exchange; add "
+                    "--mesh N to run the wave pipeline"
+                )
             s = ExternalSort(
                 run_elems=run_elems,
                 spill_dir=args.spill_dir,
@@ -1983,10 +2020,13 @@ def main(argv=None) -> int:
                        choices=["auto", "sort", "bitonic", "block_merge"],
                        help="post-shuffle combine (default auto: block_merge "
                             "wherever the block kernel applies)")
-        p.add_argument("--exchange", choices=["alltoall", "ring"],
+        p.add_argument("--exchange", choices=["alltoall", "ring", "fused"],
                        help="bucket exchange schedule (default alltoall; "
                             "ring = chunked ppermute with adaptive per-step "
-                            "headroom and merge-as-you-receive)")
+                            "headroom and merge-as-you-receive; fused = the "
+                            "same measured ring schedule as ONE Pallas "
+                            "kernel — in-kernel async remote DMAs, P-1 "
+                            "dispatches collapsed to one launch)")
         p.add_argument("--checkpoint-dir",
                        help="persist per-shard/range progress here; a re-run "
                             "of the same input resumes instead of re-sorting")
@@ -2065,7 +2105,8 @@ def main(argv=None) -> int:
                    help="time the no-relay path: device-resident sort + "
                         "on-device validation, one JSON line each")
     p.add_argument("--exchange-ab", action="store_true",
-                   help="ring-vs-alltoall exchange A/B on the local mesh "
+                   help="three-way alltoall/ring/fused exchange A/B on the "
+                        "local mesh "
                         "(uniform + zipf; asserts bit-identical outputs, "
                         "reports bytes_on_wire per schedule)")
     p.add_argument("--serve-mixed", action="store_true",
@@ -2156,6 +2197,10 @@ def main(argv=None) -> int:
     p.add_argument("--no-overlap", action="store_true",
                    help="disable the wave pipeline's spill/exchange overlap "
                         "(the A/B baseline)")
+    p.add_argument("--exchange", choices=["ring", "fused"],
+                   help="per-wave exchange schedule (wave mode; default "
+                        "ring; fused = exchange+merge as one Pallas kernel "
+                        "per wave)")
     p.add_argument("--spill-dir")
     p.add_argument("--job-id", default="external")
     p.add_argument("--no-resume", action="store_true",

@@ -100,7 +100,10 @@ class JobConfig:
     # Bucket exchange schedule: "alltoall" = one-shot padded collective;
     # "ring" = P-1 chunked ppermute steps with merge-as-you-receive and
     # per-step buffer capacities sized from the measured bucket histogram
-    # (`parallel.exchange`) — bit-identical output, adaptive headroom.
+    # (`parallel.exchange`) — bit-identical output, adaptive headroom;
+    # "fused" = the same measured-capacity ring schedule run as ONE Pallas
+    # kernel (`ops.ring_kernel`): per-step async remote DMAs with the merge
+    # folded between them, one launch instead of P-1 dispatches.
     exchange: str = "alltoall"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
@@ -167,9 +170,10 @@ class JobConfig:
                 "merge_kernel must be 'auto', 'sort', 'bitonic' or "
                 f"'block_merge', got {self.merge_kernel!r}"
             )
-        if self.exchange not in ("alltoall", "ring"):
+        if self.exchange not in ("alltoall", "ring", "fused"):
             raise ConfigError(
-                f"exchange must be 'alltoall' or 'ring', got {self.exchange!r}"
+                "exchange must be 'alltoall', 'ring' or 'fused', got "
+                f"{self.exchange!r}"
             )
         if self.oversample < 1:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")

@@ -308,7 +308,10 @@ class ExternalWaveSort:
     a dataset ``W`` times larger runs as ``W`` pipelined waves.
     ``spill_dir``/``job_id``/``resume``: the `ShardCheckpoint` (wave, run)
     store and its resume key.  ``overlap=False`` disables the pipeline
-    (the bench A/B baseline).
+    (the bench A/B baseline).  ``exchange`` ("ring" | "fused", default
+    `JobConfig.exchange` via the shared resolver): "fused" runs each
+    wave's exchange+merge as ONE Pallas kernel (`ops.ring_kernel`) — the
+    wave never leaves the device between partition and spill.
     """
 
     def __init__(
@@ -321,6 +324,7 @@ class ExternalWaveSort:
         resume: bool = True,
         overlap: bool = True,
         axis_name: str = "w",
+        exchange: str | None = None,
     ):
         if wave_elems < 2:
             raise ValueError("wave_elems must be >= 2")
@@ -343,11 +347,22 @@ class ExternalWaveSort:
         self.job = job or JobConfig()
         self.resume = resume
         self.overlap = overlap
+        # Per-wave exchange schedule through the one resolver seam
+        # (override > JobConfig.exchange): "ring" is the PR 4 lax schedule;
+        # "fused" runs each wave's exchange+merge as ONE Pallas kernel
+        # (`ops.ring_kernel`), so a wave never leaves the device between
+        # partition and spill; "alltoall" is meaningless here (the wave
+        # plan IS the measured-histogram ring plan) and maps to "ring".
+        from dsort_tpu.parallel.exchange import resolve_exchange
+
+        exch = resolve_exchange(exchange, self.job.exchange, self.num_workers)
+        self.exchange = "fused" if exch == "fused" else "ring"
         #: Test seam between a wave's plan and exchange dispatches — the
         #: same mid-ring injection point as `SampleSort.fault_hook`.
         self.fault_hook = None
         self._plan_cache: dict = {}
         self._ring_cache: dict = {}
+        self._fused_cache: dict = {}
         self._single_cache: dict = {}
 
     # -- compiled programs ---------------------------------------------------
@@ -432,6 +447,59 @@ class ExternalWaveSort:
                 ),
             )
             self._ring_cache[key] = fn
+        return fn
+
+    def _build_fused(self, n_local: int, caps: tuple):
+        """Fused per-wave exchange+merge (`ops.ring_kernel`): the wave's
+        P-1 transfer steps and the range merge run as ONE kernel launch —
+        between its partition and its spill the wave never leaves the
+        device or dispatches a second program."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from dsort_tpu.obs.prof import instrument_jit
+        from dsort_tpu.ops.ring_kernel import (
+            fused_mesh,
+            fused_ring_exchange_shard,
+        )
+        from dsort_tpu.utils.compat import shard_map
+
+        key = (n_local, caps)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            p = self.num_workers
+            body = functools.partial(
+                fused_ring_exchange_shard,
+                num_workers=p,
+                caps=caps,
+                axis=self.axis,
+                merge_kernel=self.job.merge_kernel,
+                kernel=self.job.local_kernel,
+            )
+            # Donation matches `_build_ring` (repair re-sorts from the
+            # host-resident wave slice, never this buffer).
+            donate = (
+                (0,)
+                if next(iter(self.mesh.devices.flat)).platform != "cpu"
+                else ()
+            )
+            fn = instrument_jit(
+                jax.jit(
+                    shard_map(
+                        body,
+                        mesh=fused_mesh(self.mesh, self.axis),
+                        in_specs=(P(self.axis), P(self.axis), P(), P()),
+                        out_specs=(P(self.axis),) * 3,
+                        check_vma=False,
+                    ),
+                    donate_argnums=donate,
+                ),
+                key_fn=lambda *a: (
+                    "wave_fused", p, n_local, caps, str(a[0].dtype),
+                    self.job.local_kernel,
+                ),
+            )
+            self._fused_cache[key] = fn
         return fn
 
     def _build_single(self, n_local: int):
@@ -624,7 +692,11 @@ class ExternalWaveSort:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from dsort_tpu.obs.prof import LEDGER
-        from dsort_tpu.parallel.exchange import note_ring_plan, ring_caps
+        from dsort_tpu.parallel.exchange import (
+            note_fused_plan,
+            note_ring_plan,
+            ring_caps,
+        )
 
         p = self.num_workers
         n_local = shards.shape[1]
@@ -636,6 +708,7 @@ class ExternalWaveSort:
                 merged = fn(jnp.asarray(shards[0]), int(counts[0]))
             LEDGER.drain_to(metrics)
             return merged, np.zeros(1, bool), counts.astype(np.int64)
+        fused = self.exchange == "fused"
         shard_spec = NamedSharding(self.mesh, P(self.axis))
         repl = NamedSharding(self.mesh, P())
         planfn = self._build_plan(n_local)
@@ -648,15 +721,20 @@ class ExternalWaveSort:
             hist_h = _np.asarray(jax.device_get(hist)).reshape(p, p)
         LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
-        note_ring_plan(
+        note = note_fused_plan if fused else note_ring_plan
+        note(
             metrics, caps, hist_h, n_local, p, shards.dtype.itemsize,
             self.job.capacity_factor,
         )
         if self.fault_hook is not None:
             self.fault_hook()
-        ringfn = self._build_ring(n_local, caps)
         with timer.phase("wave_exchange"):
-            merged, _, overflow = ringfn(xs_sorted, cj, spl)
+            if fused:
+                fusedfn = self._build_fused(n_local, caps)
+                merged, _, overflow = fusedfn(xs_sorted, cj, spl, hist)
+            else:
+                ringfn = self._build_ring(n_local, caps)
+                merged, _, overflow = ringfn(xs_sorted, cj, spl)
         # Keys landing on each range this wave — derived from the already
         # fetched histogram, so the retire step needs no extra scalar fetch.
         recv_lens = hist_h.sum(axis=0).astype(np.int64)

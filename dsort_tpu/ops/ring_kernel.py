@@ -1,0 +1,579 @@
+"""Fused Pallas ring: the P-1-step exchange AND the merge in ONE kernel.
+
+The lax ring (`parallel.exchange`) decomposes the bucket shuffle into P-1
+``jax.lax.ppermute`` steps and interleaves the merge tower between them —
+but each step is still its own collective the backend schedules, and on
+backends without async collectives (the CPU sim; XLA before it fuses the
+schedule) there is no true comm/compute overlap: the measured ring wins
+(1.08-1.64x) understate the structural gain, and per-step dispatch overhead
+is real at small steps (ROADMAP item 2).  This module is the kernel-level
+answer, the SNIPPETS [1]/[2] primitive grown into the whole exchange:
+
+- ONE ``pl.pallas_call`` per device runs the entire schedule.  Step ``k``'s
+  bucket leaves as an **async remote DMA** (`pltpu.make_async_remote_copy`,
+  DMA semaphores in scratch) straight into the destination's receive
+  workspace; while that copy is in flight the kernel folds step ``k-1``'s
+  received run through the in-kernel bitonic merge network, waits, and
+  advances — start, fold, wait, advance.  P-1 ppermute dispatches plus the
+  host-orchestrated merge tower become one launch
+  (`DISPATCHES_PER_FUSED_EXCHANGE`).
+- The receive workspace is laid out as **per-step slots sized from the PR 4
+  `ring_caps` ladder** (slot ``k`` is exactly ``caps[k]`` long, at a static
+  offset): the double buffer generalized to one slot per step, so the fold
+  of slot ``k-1`` can overlap the fill of slot ``k`` with no flow-control
+  handshake — every (source, step) pair writes a distinct region exactly
+  once.  Wire bytes are identical to the lax ring's (`ring_wire_bytes` on
+  the same caps).
+- The merge follows the lax ring's eager-vs-deferred doctrine
+  (`_resolve_merge_kernel`): where a genuine run-merge entry exists (the
+  block kernel's merge levels on TPU; ``merge_kernel="bitonic"``), runs
+  fold as they land through `_kmerge2` — a roll-based bitonic merge network
+  on ``(rows, 128)`` tiles, the same lane/sublane exchange trick as
+  `ops.pallas_sort._tile_bitonic_kernel` — under a binary-counter tower;
+  where the combine resolves to the flat re-sort (the CPU mesh), runs
+  collect and one in-kernel ``lax.sort`` finishes, so the fused path never
+  multiplies merge work the way an unconditional eager tower would.
+- **kv records move once.**  The PR 4 kv ring gathered payload rows twice —
+  once into each step's send buffer and AGAIN by the final tag-permutation
+  gather after the key merge.  Here payload rows ride their step's remote
+  DMA once, land step-ordered in the payload workspace, and the kernel
+  itself applies the merged tag permutation before returning — no
+  post-exchange gather op exists on the fused path, and the wire-byte model
+  (`exchange.ring_wire_bytes` at key+payload slot bytes) counts each
+  payload row exactly once.
+
+Like `ops.pallas_sort`, the kernel runs under the **Pallas interpreter** on
+non-TPU backends (the remote copies are emulated faithfully, semaphores and
+all), so bit-identical-vs-lax-ring is tier-1-testable on the 8-device CPU
+mesh before chip time; on CPU the measurable win is structural — dispatch
+count P-1 -> 1 — while the comm/compute overlap itself needs real ICI.
+Drivers select it with ``exchange="fused"`` through the same
+`exchange.resolve_exchange` seam as the ring, and the fault contract is
+unchanged: a device lost between the plan and the exchange
+(`SampleSort.fault_hook`) re-forms the mesh and re-runs on the survivors
+with a fresh plan.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dsort_tpu.ops.bitonic import _ceil_pow2
+from dsort_tpu.ops.local_sort import sentinel_for
+from dsort_tpu.parallel.exchange import (
+    _bucket_bounds,
+    _bucket_gather,
+    _pad_run as _kpad,
+    _tower_fold,
+    _tower_push,
+)
+
+LANES = 128
+
+#: The structural headline: the whole P-1-step exchange + merge is ONE
+#: kernel launch (the lax ring issues P-1 ppermute collectives the backend
+#: schedules separately).
+DISPATCHES_PER_FUSED_EXCHANGE = 1
+
+__all__ = [
+    "DISPATCHES_PER_FUSED_EXCHANGE",
+    "fused_mesh",
+    "fused_ring_exchange_shard",
+    "fused_ring_exchange_kv_shard",
+]
+
+
+def fused_mesh(mesh, axis: str):
+    """A 1-axis view of the worker axis for the fused kernel's dispatch.
+
+    The kernel addresses its remote copies by LOGICAL device id = the index
+    along the worker axis, and the Pallas remote-DMA plumbing (compiled and
+    interpreted alike) binds that id against a single named mesh axis —
+    so the standard ``('dp', 'w')`` driver mesh (dp always 1 for single-job
+    drivers) folds its size-1 batch axes away.  Sharded operands transfer
+    between the views for free: same devices in the same order, so
+    ``P(axis)`` layouts are identical.  A mesh with a REAL extra axis
+    (dp > 1, the batched driver) has no such view — callers fall back to
+    the lax ring there (`BatchSampleSort._run_bucket`).
+    """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if len(mesh.axis_names) == 1:
+        return mesh
+    extra = [a for a in mesh.axis_names if a != axis]
+    if any(int(mesh.shape[a]) != 1 for a in extra):
+        raise ValueError(
+            "exchange='fused' needs a 1-axis worker mesh (size-1 batch "
+            f"axes fold away); got axes {dict(mesh.shape)}"
+        )
+    return Mesh(np.asarray(mesh.devices).reshape(-1), (axis,))
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _resolve_interpret(interpret: bool | None) -> bool:
+    """The `ops.pallas_sort` seam: compiled on TPU, interpreted elsewhere."""
+    return not _on_tpu() if interpret is None else interpret
+
+
+# -- in-kernel building blocks ----------------------------------------------
+#
+# Everything below runs INSIDE the pallas kernel body: values only, no host
+# anything, index vectors from broadcasted_iota (kernels cannot capture
+# array constants), partner exchange via pltpu.roll on (rows, 128) tiles —
+# the exact lane/sublane trick of `ops.pallas_sort._tile_bitonic_kernel`,
+# here restricted to the ~log(2L) "clean" stages a bitonic MERGE needs.
+
+
+def _iota1(n: int):
+    """1-D int32 iota a kernel is allowed to build (2-D iota + reshape)."""
+    return jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0).reshape(-1)
+
+
+def _merge_geometry(n: int):
+    rows = n // LANES
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+    return rows, lane, row
+
+
+def _roll_partner(x2, j: int, axis: int, size: int, am_first):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return jnp.where(
+        am_first, pltpu.roll(x2, size - j, axis), pltpu.roll(x2, j, axis)
+    )
+
+
+def _kmerge_stages(x):
+    """Sort a 1-D bitonic sequence (len 2L, pow2, >= LANES) ascending.
+
+    The ~log(2L) clean stages of the bitonic merge: compare-exchange at
+    distances n/2 .. 1, every region ascending, partners fetched with two
+    rolls along exactly one tile axis (lane for d < 128, sublane above).
+    """
+    n = x.shape[0]
+    rows, lane, row = _merge_geometry(n)
+    x2 = x.reshape(rows, LANES)
+    d = n // 2
+    while d >= 1:
+        if d < LANES:
+            j, axis, idx, size = d, 1, lane, LANES
+        else:
+            j, axis, idx, size = d // LANES, 0, row, rows
+        am_first = (idx & j) == 0
+        partner = _roll_partner(x2, j, axis, size, am_first)
+        small = jnp.minimum(x2, partner)
+        big = jnp.maximum(x2, partner)
+        x2 = jnp.where(am_first, small, big)
+        d //= 2
+    return x2.reshape(-1)
+
+
+def _kmerge2(a, b, sent):
+    """Merge two sorted sentinel-padded 1-D runs inside the kernel."""
+    length = max(_ceil_pow2(max(a.shape[0], b.shape[0])), LANES)
+    a = _kpad(a, length, sent)
+    b = _kpad(b, length, sent)
+    # ascending ++ reversed(ascending) = one bitonic sequence.
+    return _kmerge_stages(jnp.concatenate([a, b[::-1]]))
+
+
+def _kmerge_stages_kv(k2, t2, rows, lane, row):
+    """Pair (key, tag) bitonic-merge stages: the swap predicate is computed
+    from the (first, second) members identically on both sides of every
+    exchange — the `_tile_bitonic_kv_kernel` consistency rule — so equal
+    keys make one decision and no tag is duplicated or lost."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = rows * LANES
+    d = n // 2
+    while d >= 1:
+        if d < LANES:
+            j, axis, idx, size = d, 1, lane, LANES
+        else:
+            j, axis, idx, size = d // LANES, 0, row, rows
+        am_first = (idx & j) == 0
+        pk = jnp.where(
+            am_first, pltpu.roll(k2, size - j, axis), pltpu.roll(k2, j, axis)
+        )
+        pt = jnp.where(
+            am_first, pltpu.roll(t2, size - j, axis), pltpu.roll(t2, j, axis)
+        )
+        fk, sk = jnp.where(am_first, k2, pk), jnp.where(am_first, pk, k2)
+        ft, st = jnp.where(am_first, t2, pt), jnp.where(am_first, pt, t2)
+        swap = (fk > sk) | ((fk == sk) & (ft > st))  # ascending everywhere
+        k2 = jnp.where(swap, pk, k2)
+        t2 = jnp.where(swap, pt, t2)
+        d //= 2
+    return k2, t2
+
+
+def _kmerge2_kv(a, b, sent, pad_tag):
+    """Merge two sorted (key, tag) 1-D run pairs, ordered by (key, tag)."""
+    ka, ta = a
+    kb, tb = b
+    length = max(_ceil_pow2(max(ka.shape[0], kb.shape[0])), LANES)
+    ka, ta = _kpad(ka, length, sent), _kpad(ta, length, pad_tag)
+    kb, tb = _kpad(kb, length, sent), _kpad(tb, length, pad_tag)
+    k = jnp.concatenate([ka, kb[::-1]])
+    t = jnp.concatenate([ta, tb[::-1]])
+    rows, lane, row = _merge_geometry(k.shape[0])
+    k2, t2 = _kmerge_stages_kv(
+        k.reshape(rows, LANES), t.reshape(rows, LANES), rows, lane, row
+    )
+    return k2.reshape(-1), t2.reshape(-1)
+
+
+def _step_offsets(caps) -> list[int]:
+    """Static workspace offset of each step's receive slot; slot 0 is the
+    device's own bucket (no transfer) at offset 0 — the flat layout the kv
+    tags index, identical to the lax ring's ``offsets``."""
+    offs = [0]
+    for c in caps:
+        offs.append(offs[-1] + int(c))
+    return offs
+
+
+# -- the kernels -------------------------------------------------------------
+
+
+def _fused_ring_kernel(*refs, num_workers, caps, axis, eager):
+    """Keys-only fused ring: P-1 remote DMAs + merge, one launch.
+
+    Refs (in order): ``send_0..send_{P-1}`` — per-step send buffers, each a
+    sorted sentinel-padded ``(caps[k],)`` run (row 0 = the device's own
+    bucket, never transferred); output ``out (total,)``; scratch: the
+    send/recv DMA semaphore arrays.  The output buffer doubles as the
+    receive workspace — step ``k``'s remote copy lands in the ``caps``-
+    sized slot at static offset ``offs[k]``, the merge consumes the slots,
+    and the final sorted run overwrites the buffer in place (every slot is
+    read before the overwrite; no separate workspace allocation exists).
+    Step ``k``'s copy is started, then the previous step's received run is
+    folded (eager) or collected (deferred flat sort) while it is in flight.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p = num_workers
+    send = refs[:p]
+    out_ref = refs[p]
+    send_sems, recv_sems = refs[p + 1], refs[p + 2]
+    me = jax.lax.axis_index(axis)
+    offs = _step_offsets(caps)
+    total = offs[p]
+    sent = sentinel_for(out_ref.dtype)
+
+    def copy(k: int):
+        dst = jax.lax.rem(me + jnp.int32(k), jnp.int32(p))
+        return pltpu.make_async_remote_copy(
+            src_ref=send[k],
+            dst_ref=out_ref.at[pl.ds(offs[k], caps[k])],
+            send_sem=send_sems.at[k],
+            recv_sem=recv_sems.at[k],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    merge2 = lambda a, b: _kmerge2(a, b, sent)
+    tower: list = []
+
+    # The schedule: start step k's DMA, fold step k-1's run while it is in
+    # flight, wait, advance.  Step 0 (the own bucket) folds under step 1's
+    # transfer.  Under the deferred (flat re-sort) combine the per-step
+    # fold degenerates to "wait" — the landed buffer is read once at the
+    # end, the exact one-shot combine the lax ring resolves to on the same
+    # mesh.
+    copy(1).start()
+    if eager:
+        _tower_push(tower, send[0][...], merge2)
+    else:
+        # The deferred combine reads the whole buffer at once, so the own
+        # bucket lands in its slot; the eager tower folds it directly.
+        out_ref[pl.ds(0, caps[0])] = send[0][...]
+    for k in range(2, p):
+        copy(k).start()
+        copy(k - 1).wait_recv()
+        if eager:
+            _tower_push(
+                tower, out_ref[pl.ds(offs[k - 1], caps[k - 1])], merge2
+            )
+    copy(p - 1).wait_recv()
+    if eager:
+        _tower_push(tower, out_ref[pl.ds(offs[p - 1], caps[p - 1])], merge2)
+        merged = _tower_fold(tower, merge2)[:total]
+    else:
+        # The flat one-shot combine (the CPU-mesh resolution): one read of
+        # the fully landed buffer; valid keys sort ahead of the sentinels.
+        merged = jax.lax.sort(out_ref[...], dimension=-1, is_stable=False)
+    # Every DMA must be fully drained before the buffer may be overwritten
+    # with the merged run (a late send reads its slot; a late receive
+    # would land under the result).
+    for k in range(1, p):
+        copy(k).wait_send()
+    out_ref[...] = merged
+
+
+def _fused_ring_kv_kernel(*refs, num_workers, caps, axis, eager):
+    """kv fused ring: keys AND payload rows cross the wire once per step.
+
+    Refs: ``sendk_0..sendk_{P-1}`` key runs, ``sendv_0..sendv_{P-1}``
+    payload row blocks, ``lens_recv (P,)`` (true length of the run this
+    device receives at each step, from the replicated plan histogram);
+    outputs ``out_k (total,)`` and ``out_v (total,) + trailing`` — both
+    double as the receive workspace (per-step slots at static offsets,
+    read before the in-place overwrite); scratch: four DMA semaphore
+    arrays (key and payload copies complete independently).
+
+    Keys merge as ``(key, tag)`` pairs with the lax kv ring's exact tag
+    plane (``offsets[step] + pos + is_pad * total``), so the merged tag
+    sequence IS the payload permutation — which the kernel applies itself
+    before returning.  No post-exchange gather op exists on this path: the
+    PR 4 double-gather (send-buffer gather + final tag-permutation gather)
+    collapses to the single in-kernel placement.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p = num_workers
+    send_k = refs[:p]
+    send_v = refs[p : 2 * p]
+    lens_recv_ref = refs[2 * p]
+    out_k_ref, out_v_ref = refs[2 * p + 1], refs[2 * p + 2]
+    sems = refs[2 * p + 3 : 2 * p + 7]  # send_k, recv_k, send_v, recv_v
+    me = jax.lax.axis_index(axis)
+    offs = _step_offsets(caps)
+    total = offs[p]
+    sent = sentinel_for(out_k_ref.dtype)
+    pad_tag = jnp.int32(2 * total)
+    lens_recv = lens_recv_ref[...]
+
+    def copy_k(k: int):
+        dst = jax.lax.rem(me + jnp.int32(k), jnp.int32(p))
+        return pltpu.make_async_remote_copy(
+            src_ref=send_k[k],
+            dst_ref=out_k_ref.at[pl.ds(offs[k], caps[k])],
+            send_sem=sems[0].at[k],
+            recv_sem=sems[1].at[k],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def copy_v(k: int):
+        dst = jax.lax.rem(me + jnp.int32(k), jnp.int32(p))
+        return pltpu.make_async_remote_copy(
+            src_ref=send_v[k],
+            dst_ref=out_v_ref.at[pl.ds(offs[k], caps[k])],
+            send_sem=sems[2].at[k],
+            recv_sem=sems[3].at[k],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def tagged(run_k, k: int):
+        # The `_ring_exchange_kv_shard.tagged` plane verbatim: flat receive
+        # position, pushed past every real tag for pads — real keys equal
+        # to the sentinel stay ahead of padding at the merge.
+        pos = _iota1(caps[k])
+        is_pad = (pos >= lens_recv[k]).astype(jnp.int32)
+        return run_k, jnp.int32(offs[k]) + pos + is_pad * total
+
+    merge2 = lambda a, b: _kmerge2_kv(a, b, sent, pad_tag)
+    tower: list = []
+
+    # The payload's own rows land in their flat slot locally (offset 0);
+    # the own key run lands too (the deferred combine reads the whole
+    # buffer, and the tag plane indexes the flat layout either way).
+    out_v_ref[pl.ds(0, caps[0])] = send_v[0][...]
+    out_k_ref[pl.ds(0, caps[0])] = send_k[0][...]
+    copy_k(1).start()
+    copy_v(1).start()
+    if eager:
+        _tower_push(tower, tagged(send_k[0][...], 0), merge2)
+    for k in range(2, p):
+        copy_k(k).start()
+        copy_v(k).start()
+        copy_k(k - 1).wait_recv()
+        if eager:
+            _tower_push(
+                tower,
+                tagged(out_k_ref[pl.ds(offs[k - 1], caps[k - 1])], k - 1),
+                merge2,
+            )
+    copy_k(p - 1).wait_recv()
+    if eager:
+        _tower_push(
+            tower,
+            tagged(out_k_ref[pl.ds(offs[p - 1], caps[p - 1])], p - 1),
+            merge2,
+        )
+        merged_k, merged_t = _tower_fold(tower, merge2)
+    else:
+        merged_k, merged_t = jax.lax.sort(
+            (
+                out_k_ref[...],
+                jnp.concatenate([tagged(None, k)[1] for k in range(p)]),
+            ),
+            dimension=-1,
+            num_keys=2,
+            is_stable=False,
+        )
+    merged_k, merged_t = merged_k[:total], merged_t[:total]
+    # The payload permutation applied IN the kernel — the single placement
+    # that replaces the lax path's final tag-permutation gather.  All P
+    # payload copies must have landed before the flat read, and every DMA
+    # must be drained before the in-place overwrite.
+    for k in range(1, p):
+        copy_v(k).wait_recv()
+    gather = jnp.where(merged_t < total, merged_t, 0)
+    # Chip-time note (ROADMAP item 2 remainder): Mosaic has no general
+    # axis-0 row gather — the compiled placement needs a per-row local-DMA
+    # loop or a sublane gather, to be validated on hardware; the
+    # interpreter executes this directly.
+    out_v = jnp.take(out_v_ref[...], gather, axis=0)
+    for k in range(1, p):
+        copy_k(k).wait_send()
+        copy_v(k).wait_send()
+    out_k_ref[...] = merged_k
+    out_v_ref[...] = out_v
+
+
+# -- shard-level entries (run under shard_map, like the lax ring's) ----------
+
+
+def _fused_eager(
+    merge_kernel: str, kernel: str, dtype, total: int, interpret: bool
+) -> bool:
+    """The lax ring's eager-vs-deferred rule, verbatim: fold-as-you-receive
+    only where a genuine run-merge entry exists; under the flat re-sort
+    combine (the CPU mesh) collect runs and sort once.  The deferred
+    combine is an in-kernel ``lax.sort``, which only the INTERPRETER can
+    execute — a compiled (TPU) launch always takes the eager roll-based
+    merge network, the only combine Mosaic can lower."""
+    from dsort_tpu.parallel.sample_sort import _resolve_merge_kernel
+
+    if not interpret:
+        return True
+    return _resolve_merge_kernel(merge_kernel, kernel, dtype, total) != "sort"
+
+
+def _send_runs(xs, starts, lens, me, caps, num_workers):
+    """Per-step send buffers + the overflow scalar: step ``k``'s run is the
+    bucket for destination ``(me+k) % P``, sentinel-padded to ``caps[k]`` —
+    the same `_bucket_gather` the lax ring uses, materialized per step so
+    each becomes one remote DMA source.  Also returns each step's gather
+    index (the kv path lifts its payload rows with it, ONCE)."""
+    p = num_workers
+    sends, idxs = [], []
+    overflow = jnp.zeros((), bool)
+    for k in range(p):
+        row = jax.lax.rem(me + jnp.int32(k), jnp.int32(p))
+        run, idx, _ = _bucket_gather(xs, starts, lens, row, int(caps[k]))
+        sends.append(run)
+        idxs.append(idx)
+        overflow = overflow | (lens[row] > caps[k])
+    return sends, idxs, overflow
+
+
+def _recv_lens(hist, me, num_workers):
+    """True length of the run received at each step, from the replicated
+    plan histogram: step ``k`` receives source ``(me-k) % P``'s bucket for
+    ``me`` — no extra collective, the plan already measured it."""
+    p = num_workers
+    col = jnp.take(hist, me, axis=1).astype(jnp.int32)  # hist[:, me]
+    srcs = jax.lax.rem(me - _iota1(p) + jnp.int32(p), jnp.int32(p))
+    return jnp.take(col, srcs), jnp.sum(col).astype(jnp.int32)
+
+
+def fused_ring_exchange_shard(
+    xs, count, splitters, hist, *, num_workers, caps, axis,
+    merge_kernel="auto", kernel="lax", interpret=None,
+):
+    """Fused counterpart of `exchange._ring_exchange_shard`: same contract
+    (``(merged (total,), out_count (1,), overflow (1,))``, bit-identical
+    output), but the P-1 transfer steps and the merge run as ONE
+    ``pallas_call``.  ``hist`` is the plan's replicated ``(P, P)`` histogram
+    — it supplies the output count (the lax ring ppermutes lengths instead)
+    so nothing outside the kernel ever communicates."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p = num_workers
+    count = count[0]
+    me = jax.lax.axis_index(axis)
+    starts, lens = _bucket_bounds(xs, count, splitters)
+    caps = tuple(int(c) for c in caps)
+    total = int(sum(caps))
+    sends, _, overflow = _send_runs(xs, starts, lens, me, caps, p)
+    _, out_count = _recv_lens(hist, me, p)
+    interp = _resolve_interpret(interpret)
+    eager = _fused_eager(merge_kernel, kernel, xs.dtype, total, interp)
+    anyspec = pl.BlockSpec(memory_space=pltpu.ANY)
+    out = pl.pallas_call(
+        functools.partial(
+            _fused_ring_kernel,
+            num_workers=p, caps=caps, axis=axis, eager=eager,
+        ),
+        out_shape=jax.ShapeDtypeStruct((total,), xs.dtype),
+        in_specs=[anyspec] * p,
+        out_specs=anyspec,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((p,)),
+            pltpu.SemaphoreType.DMA((p,)),
+        ],
+        interpret=interp,
+    )(*sends)
+    return out, out_count[None], overflow[None]
+
+
+def fused_ring_exchange_kv_shard(
+    keys, payload, count, splitters, hist, *, num_workers, caps, axis,
+    merge_kernel="auto", kernel="lax", interpret=None,
+):
+    """Fused counterpart of `exchange._ring_exchange_kv_shard`: keys AND
+    payload rows ride one remote DMA per step, the (key, tag) merge and the
+    payload placement both happen inside the kernel — the payload is
+    gathered exactly once (into its send block) and never again."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p = num_workers
+    count = count[0]
+    me = jax.lax.axis_index(axis)
+    starts, lens = _bucket_bounds(keys, count, splitters)
+    caps = tuple(int(c) for c in caps)
+    total = int(sum(caps))
+    sends_k, idxs, overflow = _send_runs(keys, starts, lens, me, caps, p)
+    sends_v = [payload[idx] for idx in idxs]
+    lens_recv, out_count = _recv_lens(hist, me, p)
+    trailing = tuple(payload.shape[1:])
+    # The kv tower's only genuine run-merge entry mirrors the lax rule:
+    # everything except the flat re-sort folds eagerly (the in-kernel pair
+    # network carries the tag plane for every merge_kernel choice).
+    interp = _resolve_interpret(interpret)
+    eager = _fused_eager(merge_kernel, kernel, keys.dtype, total, interp)
+    anyspec = pl.BlockSpec(memory_space=pltpu.ANY)
+    out_k, out_v = pl.pallas_call(
+        functools.partial(
+            _fused_ring_kv_kernel,
+            num_workers=p, caps=caps, axis=axis, eager=eager,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((total,), keys.dtype),
+            jax.ShapeDtypeStruct((total,) + trailing, payload.dtype),
+        ),
+        in_specs=[anyspec] * (2 * p + 1),
+        out_specs=(anyspec,) * 2,
+        scratch_shapes=[pltpu.SemaphoreType.DMA((p,)) for _ in range(4)],
+        interpret=interp,
+    )(*sends_k, *sends_v, lens_recv)
+    return out_k, out_v, out_count[None], overflow[None]

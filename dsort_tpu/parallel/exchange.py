@@ -64,7 +64,9 @@ __all__ = [
     "ring_step_quantum",
     "ring_wire_bytes",
     "alltoall_wire_bytes",
+    "dispatches_per_exchange",
     "note_ring_plan",
+    "note_fused_plan",
     "note_alltoall_attempt",
     "resolve_exchange",
     "check_ring_overflow",
@@ -76,13 +78,25 @@ def resolve_exchange(value: str | None, default: str, num_workers: int) -> str:
     """THE exchange-schedule resolver, shared by every driver: per-call
     override > config default; a 1-worker mesh always takes the all_to_all
     path (the shard program short-circuits after the local sort — there is
-    nothing to exchange)."""
+    nothing to exchange).  "fused" is the single-kernel ring
+    (`ops.ring_kernel`): same plan, same caps, same fault contract, the
+    P-1 transfer steps and the merge in one Pallas launch."""
     exch = value if value is not None else default
-    if exch not in ("alltoall", "ring"):
+    if exch not in ("alltoall", "ring", "fused"):
         raise ValueError(
-            f"exchange must be 'alltoall' or 'ring', got {exch!r}"
+            f"exchange must be 'alltoall', 'ring' or 'fused', got {exch!r}"
         )
     return "alltoall" if num_workers == 1 else exch
+
+
+def dispatches_per_exchange(exchange: str, num_workers: int) -> int:
+    """Transfer dispatches one exchange issues — the structural A/B axis of
+    the fused kernel: the lax ring schedules ``P-1`` separate ppermute
+    collectives, the padded path one all_to_all, the fused ring ONE
+    ``pallas_call`` containing every step (`ops.ring_kernel`)."""
+    if exchange == "ring":
+        return max(num_workers - 1, 1)
+    return 1
 
 
 def note_alltoall_attempt(
@@ -276,6 +290,45 @@ def note_ring_plan(
                 "exchange_resize", step=k, cap=int(caps[k]),
                 observed=maxes[k], policy_cap=policy_cap,
             )
+
+
+def note_fused_plan(
+    metrics, caps, hist, n_local: int, num_workers: int, bytes_per_slot: int,
+    capacity_factor: float, jobs: int = 1,
+) -> None:
+    """Journal one planned FUSED ring schedule (`ops.ring_kernel`).
+
+    The fused kernel runs the exact schedule the lax ring would — same
+    measured caps, same wire bytes, same skew signal — so the shared
+    accounting (`note_ring_plan`: ``skew_report``, ``exchange_step``, the
+    wire-byte counters) rides every fused run unchanged.  On top of it, the
+    fused plane records what is structurally different: ONE kernel launch
+    replaces the ``P-1`` per-step collective dispatches
+    (``fused_exchange_launch`` / `ring_kernel.DISPATCHES_PER_FUSED_EXCHANGE`)
+    and each step becomes an in-kernel async remote copy
+    (``fused_exchange_step`` events, ``fused_exchange_steps`` counter).
+    """
+    from dsort_tpu.ops.ring_kernel import DISPATCHES_PER_FUSED_EXCHANGE
+
+    p = num_workers
+    note_ring_plan(
+        metrics, caps, hist, n_local, p, bytes_per_slot, capacity_factor,
+        jobs=jobs,
+    )
+    metrics.bump("fused_exchange_launches", jobs)
+    metrics.bump("fused_exchange_steps", (p - 1) * jobs)
+    metrics.event(
+        "fused_exchange_launch",
+        steps=p - 1,
+        dispatches=DISPATCHES_PER_FUSED_EXCHANGE,
+        dispatches_replaced=p - 1,
+        total_cap=int(sum(caps)),
+    )
+    for k in range(1, p):
+        metrics.event(
+            "fused_exchange_step", step=k, cap=int(caps[k]),
+            bytes=int(caps[k]) * bytes_per_slot * p * jobs,
+        )
 
 
 # -- shard-level building blocks (run under shard_map) ----------------------

@@ -602,7 +602,59 @@ class SampleSort:
             ),
         )
 
-    def _dispatch_keys_ring(self, data: np.ndarray, timer, metrics: Metrics):
+    @functools.lru_cache(maxsize=32)
+    def _build_fused(
+        self, n_local: int, caps: tuple, kv_trailing: tuple | None = None
+    ):
+        """Fused ring exchange (`ops.ring_kernel`): the whole P-1-step
+        schedule plus the merge as ONE ``pallas_call`` per device.
+
+        Same plan, same measured ``caps``, same cache-key ladder as
+        `_build_ring`; the extra replicated ``hist`` input supplies the
+        output counts (the lax ring ppermutes lengths instead), so the
+        shard program issues exactly one transfer dispatch.
+        """
+        from dsort_tpu.ops.ring_kernel import (
+            fused_mesh,
+            fused_ring_exchange_kv_shard,
+            fused_ring_exchange_shard,
+        )
+
+        kwargs = dict(
+            num_workers=self.num_workers,
+            caps=caps,
+            axis=self.axis,
+            merge_kernel=self.job.merge_kernel,
+            kernel=self.job.local_kernel,
+        )
+        if kv_trailing is None:
+            fn = functools.partial(fused_ring_exchange_shard, **kwargs)
+            in_specs = (P(self.axis), P(self.axis), P(), P())
+            out_specs = (P(self.axis),) * 3
+        else:
+            fn = functools.partial(fused_ring_exchange_kv_shard, **kwargs)
+            in_specs = (P(self.axis), P(self.axis), P(self.axis), P(), P())
+            out_specs = (P(self.axis),) * 4
+        # Donation policy matches `_build_ring`: no retry exists past the
+        # plan, the sorted keys buffer is dead after this dispatch.
+        tag = "spmd_fused" if kv_trailing is None else "spmd_fused_kv"
+        return instrument_jit(
+            jax.jit(
+                shard_map(
+                    fn, mesh=fused_mesh(self.mesh, self.axis),
+                    in_specs=in_specs, out_specs=out_specs, check_vma=False,
+                ),
+                donate_argnums=self._donate_keys(kv_trailing is not None),
+            ),
+            key_fn=lambda *a: (
+                tag, self.num_workers, n_local, caps, str(a[0].dtype),
+                self.job.local_kernel,
+            ),
+        )
+
+    def _dispatch_keys_ring(
+        self, data: np.ndarray, timer, metrics: Metrics, fused: bool = False
+    ):
         """Ring counterpart of `_dispatch_keys`: plan, size, exchange.
 
         No capacity-retry loop exists here — the plan phase measured the
@@ -614,6 +666,7 @@ class SampleSort:
         """
         from dsort_tpu.parallel.exchange import (
             check_ring_overflow,
+            note_fused_plan,
             note_ring_plan,
             ring_caps,
         )
@@ -633,15 +686,22 @@ class SampleSort:
             hist_h = jax.device_get(hist)
         LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
-        note_ring_plan(
+        note = note_fused_plan if fused else note_ring_plan
+        note(
             metrics, caps, hist_h, n_local, p, data.dtype.itemsize,
             self.job.capacity_factor,
         )
         if self.fault_hook is not None:
             self.fault_hook()
-        ringfn = self._build_ring(n_local, caps)
         with timer.phase("spmd_sort"):
-            merged, out_counts, overflow = ringfn(xs_sorted, cj, splitters)
+            if fused:
+                fusedfn = self._build_fused(n_local, caps)
+                merged, out_counts, overflow = fusedfn(
+                    xs_sorted, cj, splitters, hist
+                )
+            else:
+                ringfn = self._build_ring(n_local, caps)
+                merged, out_counts, overflow = ringfn(xs_sorted, cj, splitters)
             # One fetch = completion barrier + the invariant scalar (same
             # doctrine as the all_to_all path).
             c, ov = jax.device_get((out_counts, overflow))
@@ -651,16 +711,20 @@ class SampleSort:
 
     def _dispatch_kv_ring(
         self, xs, vs, cj, n_local: int, trailing: tuple, slot_bytes: int,
-        timer, metrics: Metrics,
+        timer, metrics: Metrics, fused: bool = False,
     ):
         """kv ring dispatch: plan (kv local sort + histogram), size, exchange.
 
         The payload stays device-resident between the two dispatches and
         rides the ppermute steps next to its keys; ``slot_bytes`` (key +
-        payload row) prices the wire-bytes accounting.
+        payload row) prices the wire-bytes accounting — the payload rows
+        count ONCE per step on both the lax and the fused schedule (on the
+        fused path they also move exactly once: the kernel places them by
+        the merged tags itself, no post-exchange gather).
         """
         from dsort_tpu.parallel.exchange import (
             check_ring_overflow,
+            note_fused_plan,
             note_ring_plan,
             ring_caps,
         )
@@ -672,15 +736,24 @@ class SampleSort:
             hist_h = jax.device_get(hist)
         LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
-        note_ring_plan(
+        note = note_fused_plan if fused else note_ring_plan
+        note(
             metrics, caps, hist_h, n_local, p, slot_bytes,
             self.job.capacity_factor,
         )
         if self.fault_hook is not None:
             self.fault_hook()
-        ringfn = self._build_ring(n_local, caps, kv_trailing=trailing)
         with timer.phase("spmd_sort"):
-            out_k, out_v, out_counts, overflow = ringfn(ks, vsort, cj, splitters)
+            if fused:
+                fusedfn = self._build_fused(n_local, caps, kv_trailing=trailing)
+                out_k, out_v, out_counts, overflow = fusedfn(
+                    ks, vsort, cj, splitters, hist
+                )
+            else:
+                ringfn = self._build_ring(n_local, caps, kv_trailing=trailing)
+                out_k, out_v, out_counts, overflow = ringfn(
+                    ks, vsort, cj, splitters
+                )
             c, ov = jax.device_get((out_counts, overflow))
         LEDGER.drain_to(metrics)
         check_ring_overflow(ov)
@@ -707,11 +780,14 @@ class SampleSort:
         device-resident representation would be the mapped ordered uints,
         which a next jitted stage must not mistake for values.
 
-        ``exchange`` ("alltoall" | "ring") overrides `JobConfig.exchange`
-        for this call: "ring" replaces the one-shot padded ``all_to_all``
-        with the adaptive ppermute schedule of `parallel.exchange` —
-        bit-identical output, actual-histogram buffer sizing, and the merge
-        overlapped with the transfers.
+        ``exchange`` ("alltoall" | "ring" | "fused") overrides
+        `JobConfig.exchange` for this call: "ring" replaces the one-shot
+        padded ``all_to_all`` with the adaptive ppermute schedule of
+        `parallel.exchange` — bit-identical output, actual-histogram buffer
+        sizing, and the merge overlapped with the transfers; "fused" runs
+        that same measured schedule as ONE Pallas kernel per device
+        (`ops.ring_kernel`: in-kernel async remote DMAs, merge folded
+        between the steps, P-1 dispatches collapsed to one launch).
         """
         data = np.asarray(data)
         if keep_on_device:
@@ -796,8 +872,11 @@ class SampleSort:
         fetched (the ONE small device->host fetch that is both the
         completion barrier and every retry scalar).
         """
-        if self._resolve_exchange(exchange) == "ring":
-            return self._dispatch_keys_ring(data, timer, metrics)
+        exch = self._resolve_exchange(exchange)
+        if exch in ("ring", "fused"):
+            return self._dispatch_keys_ring(
+                data, timer, metrics, fused=exch == "fused"
+            )
         p = self.num_workers
         shard_spec = NamedSharding(self.mesh, P(self.axis))
         with timer.phase("partition"):
@@ -925,13 +1004,13 @@ class SampleSort:
                 exchange=exchange,
             )
         exch = self._resolve_exchange(exchange)
-        if exch == "ring" and secondary is not None:
+        if exch in ("ring", "fused") and secondary is not None:
             # The ring's tag plane carries (is_pad, position); adding the
             # secondary would need a third merge channel per fold — the
             # two-level-key job keeps the one-shot lax.sort combine.
             log.warning(
-                "exchange='ring' does not support a secondary key; using "
-                "the all_to_all exchange"
+                "exchange=%r does not support a secondary key; using "
+                "the all_to_all exchange", exch,
             )
             exch = "alltoall"
         if secondary is not None and self.job.merge_kernel not in ("sort", "auto"):
@@ -966,10 +1045,10 @@ class SampleSort:
         slot_bytes = keys.dtype.itemsize + int(
             np.prod(sv.shape[2:], dtype=np.int64)
         ) * sv.dtype.itemsize
-        if exch == "ring":
+        if exch in ("ring", "fused"):
             out_k, out_v, c = self._dispatch_kv_ring(
                 xs, vs, cj, n_local, tuple(sv.shape[2:]), slot_bytes,
-                timer, metrics,
+                timer, metrics, fused=exch == "fused",
             )
         else:
             cap_pair = self._cap_pair(n_local, self.job.capacity_factor)
@@ -1399,6 +1478,16 @@ class BatchSampleSort:
             else:
                 xj, cj = jax.device_put((ks, cs), sharding)
         exch = self._resolve_exchange(exchange)
+        if exch == "fused":
+            # The fused kernel addresses its remote copies by the worker
+            # axis index; under the batched 2-D (dp, w) mesh the logical
+            # device id needs the dp coordinate too — the batch keeps the
+            # lax ring (same caps, same bytes, P-1 dispatches per bucket).
+            log.warning(
+                "exchange='fused' is single-job only; the batch uses the "
+                "lax ring exchange"
+            )
+            exch = "ring"
         if exch == "ring" and kv:
             # The batched kv path keeps the one-shot exchange for now: a
             # per-bucket payload-plane ring adds little over the key-only

@@ -105,6 +105,13 @@ EVENT_TYPES: dict[str, str] = {
     "hbm_watermark": "a --memwatch device-memory snapshot at a phase "
                      "boundary (phase, edge, bytes_in_use, "
                      "max_device_bytes, source)",
+    # Fused Pallas ring kernel (ops.ring_kernel, ARCHITECTURE §11):
+    "fused_exchange_launch": "one fused ring kernel launch replaced the "
+                             "P-1 per-step collective dispatches (steps, "
+                             "dispatches, dispatches_replaced, total_cap)",
+    "fused_exchange_step": "one planned in-kernel async-remote-copy step of "
+                           "the fused ring (step, cap, bytes) — the fused "
+                           "twin of exchange_step",
     # Out-of-core wave pipeline (models.wave_sort, ARCHITECTURE §10):
     "wave_start": "one input wave entered the mesh pipeline "
                   "(wave, n_keys)",
@@ -169,6 +176,10 @@ COUNTERS: dict[str, str] = {
                         "(obs.prof; each carries cost/HBM analysis)",
     "hbm_watermarks": "device-memory snapshots taken at phase boundaries "
                       "(--memwatch)",
+    "fused_exchange_launches": "fused ring kernel launches (each replaces "
+                               "P-1 per-step exchange dispatches)",
+    "fused_exchange_steps": "async-remote-copy steps executed inside fused "
+                            "ring kernel launches",
     "waves_sorted": "input waves run through the mesh exchange pipeline",
     "wave_runs_resorted": "(wave, run) store entries re-sorted by the "
                           "run-granular resume/repair path",

@@ -1,0 +1,32 @@
+"""Fixture: fused-ring-kernel-shaped tracing violations (DS301/DS302).
+
+The failure modes the checkers must pin on `ops.ring_kernel`-style code: a
+kernel body that journals or reads clocks (it would fire once at trace
+time, claiming DMA steps that never ran), and launch geometry — the
+pallas_call ``grid``/``out_shape`` — fed from a traced parameter instead of
+the static caps tuple."""
+
+import functools
+import time
+
+import jax
+
+
+def _fused_kernel(send_ref, out_ref, metrics):
+    # DS301: journals one "step" at TRACE time, not per launch.
+    metrics.event("fused_exchange_step", step=1)
+    t0 = time.monotonic()  # DS301: clock read baked into the kernel
+    print("dma in flight", t0)  # DS301
+    out_ref[...] = send_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bad_fused_geometry(send, total, interpret):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _fused_kernel,
+        grid=(total,),  # DS302: total is traced, not in static_argnames
+        out_shape=jax.ShapeDtypeStruct((total,), send.dtype),  # DS302
+        interpret=interpret,
+    )(send)

@@ -1,0 +1,39 @@
+"""Fixture: near-miss twin of bad_ring_kernel — the real module's shape.
+
+Launch geometry derives from the STATIC caps tuple (a python value closed
+over via functools.partial, exactly `ops.ring_kernel`'s pattern) or from
+shapes, and every journal emission happens on the host around the dispatch,
+never inside the kernel."""
+
+import functools
+import time
+
+import jax
+
+
+def _fused_kernel(send_ref, out_ref, *, caps):
+    # Pure kernel body: caps is a static python tuple, no host effects.
+    out_ref[...] = send_ref[...]
+
+
+def _launch(send, caps, interpret):
+    from jax.experimental import pallas as pl
+
+    total = int(sum(caps))  # static: caps is a python tuple
+    return pl.pallas_call(
+        functools.partial(_fused_kernel, caps=caps),
+        grid=(len(caps),),
+        out_shape=jax.ShapeDtypeStruct((total,), send.dtype),
+        interpret=interpret,
+    )(send)
+
+
+def host_driver(send, caps, metrics):
+    # NOT traced: the fused plan journals its schedule on the host, then
+    # dispatches ONE launch — the `note_fused_plan` shape.
+    t0 = time.monotonic()
+    for k, cap in enumerate(caps[1:], start=1):
+        metrics.event("fused_exchange_step", step=k, cap=cap)
+    out = _launch(send, caps, interpret=True)
+    metrics.event("fused_exchange_launch", steps=len(caps) - 1)
+    return out, time.monotonic() - t0

@@ -70,6 +70,18 @@ def test_tracing_checker_fixture():
     assert run_fixture("good_tracing.py") == []
 
 
+def test_ring_kernel_fixture():
+    """ISSUE 11: the fused-ring-kernel failure modes stay pinned — a
+    journaling/clock-reading kernel body (DS301) and non-static
+    grid/out_shape launch geometry (DS302) must be caught; the real
+    module's shape (static caps tuple, host-side note_fused_plan
+    journaling) stays clean."""
+    diags = run_fixture("bad_ring_kernel.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS301": 3, "DS302": 2}
+    assert run_fixture("good_ring_kernel.py") == []
+
+
 def test_obs_fixture():
     """The telemetry plane's discipline contract: recorder-ring state stays
     lock-guarded with no blocking work under the lock, and nothing scrapes
