@@ -32,6 +32,11 @@ serve-smoke:  ## mixed small/large two-tenant workload through the real serving 
 	$(PY) -m dsort_tpu.cli bench --serve-mixed --n 400000 --reps 1 \
 	--journal /tmp/dsort_serve_smoke.jsonl
 
+fleet-smoke:  ## federated serving: 2 local agents behind a fleet controller, locality-vs-random routing A/B (8-device cpu mesh)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m dsort_tpu.cli bench --fleet-mixed --n 20000 --reps 1 \
+	--journal /tmp/dsort_fleet_smoke.jsonl
+
 profile-smoke:  ## introspection-plane cost proof: ring sort with vs without journal+ledger+memwatch (8-device cpu mesh)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m dsort_tpu.cli bench --analyze-smoke --n 1048576 --reps 2 \
@@ -67,4 +72,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke profile-smoke external-smoke bench-compare native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke profile-smoke external-smoke bench-compare native tsan asan ubsan sanitize

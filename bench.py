@@ -120,6 +120,11 @@ BENCH_SCHEMA_FIELD_TYPES = {
     "ring_keys_per_sec": "num",
     "speedup_vs_ring": "num",
     "fused_launches_per_sort": "num",
+    # Federated fleet row (`dsort bench --fleet-mixed`, ISSUE 12):
+    "agents": "num",
+    "cache_hit_rate_random": "num",
+    "speedup_vs_random": "num",
+    "rerouted": "num",
 }
 
 _SCHEMA_TYPE_CHECKS = {
@@ -1165,6 +1170,45 @@ print(json.dumps({
     except Exception as e:  # the ladder must never sink the artifact
         _emit(
             "external_wave_sort_uniform_8M_8dev_cpu_mesh", 0.0, "keys/sec",
+            baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
+
+    # Federated fleet row (ISSUE 12 / ROADMAP item 1): two local
+    # mesh-owning agents behind a fleet controller over real TCP, mixed
+    # tenants/sizes, locality-vs-random routing A/B — locality must beat
+    # random on the fleet-wide variant-cache hit rate with bit-identical
+    # outputs and the PR 7 fairness bound.  The harness is `dsort bench
+    # --fleet-mixed` — ONE copy of the contract, shared with `make
+    # fleet-smoke`.
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "dsort_tpu.cli", "bench",
+                "--fleet-mixed", "--n", str(200_000), "--reps", "1",
+            ],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        rows = []
+        for ln in r.stdout.strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        for row in rows:
+            row["metric"] += "_8dev_cpu_mesh"
+            _emit_line(row)
+        if not rows:
+            raise RuntimeError(
+                f"fleet-mixed emitted no rows (rc {r.returncode}): "
+                + (r.stderr.strip().splitlines() or ["no stderr"])[-1][:160]
+            )
+    except Exception as e:  # the ladder must never sink the artifact
+        _emit(
+            "fleet_mixed_workload_2agents_8dev_cpu_mesh", 0.0, "jobs/sec",
             baseline=False,
             error=(str(e).splitlines() or [repr(e)])[0][:200],
         )

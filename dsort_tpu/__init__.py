@@ -35,9 +35,20 @@ Package layout:
 
 __version__ = "0.1.0"
 
-from dsort_tpu.config import (  # noqa: F401
-    JobConfig,
-    MeshConfig,
-    SortConfig,
-    load_conf_file,
-)
+# Lazy config re-exports (PEP 562): `config` imports the backend (jnp
+# dtypes), and the fleet control plane (`fleet.controller`, ARCHITECTURE
+# §12) must be importable in a process that never initializes JAX — so the
+# package root cannot import config eagerly.
+_CONFIG_NAMES = ("JobConfig", "MeshConfig", "SortConfig", "load_conf_file")
+
+
+def __getattr__(name):
+    if name in _CONFIG_NAMES:
+        from dsort_tpu import config
+
+        return getattr(config, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_CONFIG_NAMES))

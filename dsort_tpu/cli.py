@@ -663,6 +663,152 @@ def _serve_loop(args, cfg, service, dtype, journal) -> int:
     return 0
 
 
+def cmd_fleet_agent(args) -> int:
+    """Run one fleet execution agent: a process owning a mesh (or mesh
+    slice), serving jobs routed to it by a `dsort fleet` controller over
+    the framed-JSON fleet protocol (ARCHITECTURE §12).
+
+    Wraps the full serving core (`serve.SortService` — slice packing,
+    variant cache, eviction/readmission) behind a TCP endpoint; the
+    agent advertises its compiled-variant/ledger keys in heartbeats so
+    the controller can route by cache locality.  ``--metrics-port``
+    exposes the live per-mesh telemetry (`dsort top URL1 URL2 ...`
+    renders the fleet view).  SIGINT/SIGTERM DRAIN: in-flight and queued
+    jobs complete (results held for the controller), new fleet submits
+    are refused with the typed ``shutting_down`` verdict, and the agent
+    exits 0.
+    """
+    import signal
+
+    from dsort_tpu.fleet.agent import FleetAgent
+
+    cfg = _load_config(args)
+    journal = _open_journal(args)
+    telemetry = server = None
+    if getattr(args, "metrics_port", None) is not None:
+        from dsort_tpu.obs import MetricsServer, Telemetry
+
+        telemetry = Telemetry()
+        server = MetricsServer(telemetry, port=args.metrics_port)
+        log.info("agent metrics endpoint: %s", server.url)
+    service = _make_serve_service(args, cfg, journal, telemetry)
+    agent = FleetAgent(
+        service=service, host=args.host, port=args.port,
+        agent_id=args.agent_id, journal=journal,
+        journal_path=getattr(args, "journal", None),
+    )
+    print(f"fleet agent {agent.agent_id} listening on {agent.addr}",
+          flush=True)
+    stop = threading.Event()
+
+    def _on_term(signum, frame):
+        stop.set()
+
+    old = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            old[sig] = signal.signal(sig, _on_term)
+        except ValueError:
+            pass  # not the main thread (tests)
+    try:
+        stop.wait()
+        log.warning("agent %s draining before exit", agent.agent_id)
+        agent.close(drain=True)
+    finally:
+        for sig, handler in old.items():
+            signal.signal(sig, handler)
+        if journal is not None:
+            _write_journal(journal, args)
+        if server is not None:
+            server.close()
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """The fleet controller REPL: `dsort serve`'s workflow, routed over
+    many mesh-owning agent processes (ARCHITECTURE §12).
+
+    A pure control plane — admission, weighted-DRR fairness, SLO
+    shedding, variant-cache-locality routing.  The CONTROLLER LIBRARY
+    (`fleet.controller`) never imports a backend (test-pinned); this CLI
+    wrapper does touch jax config for the shared `dsort` config surface —
+    embed `FleetController` directly for a truly backend-free process.
+    Each input line submits a job (``tenant=acme data.txt``)
+    which is spooled, queued, and dispatched to an agent from
+    ``--agents host:port,...`` (conf ``FLEET_AGENTS``).  With
+    ``--state-dir`` every transition persists, so a controller restart
+    loses no job: in-flight work keeps running on its agents and
+    re-attaches via the journaled job ids; queued jobs drain in the same
+    DRR order.  ``--routing random`` is the locality A/B baseline.
+    SIGINT/SIGTERM drain like ``dsort serve``.
+    """
+    import dataclasses
+    import signal
+
+    from dsort_tpu.fleet.controller import FleetController
+    from dsort_tpu.serve.fair import parse_weights
+
+    cfg = _load_config(args)
+    dtype = np.dtype(cfg.job.key_dtype)
+    journal = _open_journal(args)
+    fleet_cfg = cfg.fleet
+    if getattr(args, "state_dir", None):
+        fleet_cfg = dataclasses.replace(fleet_cfg, state_dir=args.state_dir)
+    if getattr(args, "routing", None):
+        fleet_cfg = dataclasses.replace(fleet_cfg, routing=args.routing)
+    agents = getattr(args, "agents", None) or ",".join(fleet_cfg.agents)
+    if not agents:
+        raise SystemExit(
+            "dsort fleet needs --agents host:port,... (or conf FLEET_AGENTS)"
+        )
+    telemetry = server = None
+    if getattr(args, "metrics_port", None) is not None:
+        from dsort_tpu.obs import MetricsServer, Telemetry
+
+        telemetry = Telemetry()
+        server = MetricsServer(telemetry, port=args.metrics_port)
+        log.info("controller metrics endpoint: %s", server.url)
+    controller = FleetController(
+        agents,
+        state_dir=fleet_cfg.state_dir,
+        max_queue_depth=args.queue_limit or cfg.serve.max_queue_depth,
+        max_tenant_inflight=args.tenant_limit or cfg.serve.max_tenant_inflight,
+        drr_quantum_keys=cfg.serve.drr_quantum_keys,
+        tenant_weights=(
+            parse_weights(args.weights) if getattr(args, "weights", None)
+            else dict(cfg.serve.tenant_weights)
+        ),
+        slo_shed_ms=args.slo_shed_ms or cfg.serve.slo_shed_ms,
+        routing=fleet_cfg.routing,
+        heartbeat_s=fleet_cfg.heartbeat_s,
+        default_tenant=cfg.job.tenant,
+        journal=journal,
+        journal_path=getattr(args, "journal", None),
+        telemetry=telemetry,
+    )
+    if controller.stats()["agents"] == 0:
+        log.warning(
+            "no agents reachable: submissions are REJECTED with the typed "
+            "verdict 'no_capacity' until one connects (heartbeat retries "
+            "every %.1fs)", fleet_cfg.heartbeat_s,
+        )
+    old_term = None
+    try:
+        old_term = signal.signal(signal.SIGTERM, _sigterm_to_interrupt)
+    except ValueError:
+        pass
+    try:
+        # The controller implements the SortService REPL surface (submit/
+        # stats/shutdown + future-style tickets), so the serve loop drives
+        # it unchanged — one copy of the REPL contract.
+        return _serve_loop(args, cfg, controller, dtype, journal)
+    finally:
+        if old_term is not None:
+            signal.signal(signal.SIGTERM, old_term)
+        if server is not None:
+            server.close()
+
+
 _REF_KEYS_PER_SEC = 16_384 / 0.374  # BASELINE.md measured reference throughput
 
 
@@ -1046,6 +1192,32 @@ def _bench_exchange_ab(args, cfg: SortConfig) -> int:
     return 0 if ok_all else 1
 
 
+def _queue_fairness(events, tenants) -> tuple[float, float]:
+    """``(p95_wait_s, fairness_p95_ratio)`` from journaled ``job_dequeued``
+    records — THE fairness computation both serving benchmarks share.
+    Big jobs are excluded from the per-tenant comparison: a large job's
+    long wait is its deficit-round-robin cost paying off (it must
+    accumulate the whole mesh), not a tenant being starved."""
+    waits: dict[str, list[float]] = {}
+    all_waits: list[float] = []
+    for e in events:
+        if e.type == "job_dequeued":
+            w = float(e.fields.get("wait_s", 0.0))
+            all_waits.append(w)
+            if not e.fields.get("big"):
+                waits.setdefault(e.fields.get("tenant", "?"), []).append(w)
+    p95 = float(np.percentile(all_waits, 95)) if all_waits else 0.0
+    tenant_p95 = {
+        t: float(np.percentile(ws, 95))
+        for t, ws in waits.items() if t in tenants and ws
+    }
+    fairness = (
+        max(tenant_p95.values()) / max(min(tenant_p95.values()), 1e-9)
+        if len(tenant_p95) > 1 else 1.0
+    )
+    return p95, fairness
+
+
 def _bench_serve_mixed(args, cfg: SortConfig) -> int:
     """`dsort bench --serve-mixed`: the multi-tenant serving benchmark.
 
@@ -1144,26 +1316,7 @@ def _bench_serve_mixed(args, cfg: SortConfig) -> int:
             journal.flush_jsonl(args.journal)
     except OSError as e:
         log.warning("serve-mixed journal write failed: %s", e)
-    waits: dict[str, list[float]] = {}
-    all_waits: list[float] = []
-    for e in journal.events()[mixed_start:]:
-        if e.type == "job_dequeued":
-            w = float(e.fields.get("wait_s", 0.0))
-            all_waits.append(w)
-            # The fairness ratio compares LIKE costs: the large job's long
-            # wait is its deficit-round-robin cost paying off (it must
-            # accumulate the whole mesh), not a tenant being starved.
-            if not e.fields.get("big"):
-                waits.setdefault(e.fields.get("tenant", "?"), []).append(w)
-    p95 = float(np.percentile(all_waits, 95)) if all_waits else 0.0
-    tenant_p95 = {
-        t: float(np.percentile(ws, 95))
-        for t, ws in waits.items() if t in tenants and ws
-    }
-    fairness = (
-        max(tenant_p95.values()) / max(min(tenant_p95.values()), 1e-9)
-        if len(tenant_p95) > 1 else 1.0
-    )
+    p95, fairness = _queue_fairness(journal.events()[mixed_start:], tenants)
     ok = ok_serial and ok_packed and ok_mixed
     jobs_total = len(small_jobs) + 1
     print(json.dumps({
@@ -1406,11 +1559,166 @@ def _bench_analyze_smoke(args, cfg: SortConfig) -> int:
     return 0 if ok else 1
 
 
+def _bench_fleet_mixed(args, cfg: SortConfig) -> int:
+    """`dsort bench --fleet-mixed`: the federated serving benchmark.
+
+    The `make fleet-smoke` target and THE acceptance harness for the
+    fleet plane (ARCHITECTURE §12): TWO local execution agents — each a
+    real `FleetAgent` over its own half of the device mesh, spoken to
+    over real TCP — behind a `FleetController`, driven with a mixed
+    workload (4 small jobs x 3 tenants at two repeat sizes, twice, plus
+    one large full-mesh job) under BOTH routing policies.  The A/B axis
+    is variant-cache locality: under ``routing="locality"`` repeat-size
+    jobs stick to the agent that already compiled their ladder rung,
+    under ``routing="random"`` they scatter and both agents pay the
+    compile — the row carries both fleet-wide hit rates and exits
+    nonzero unless locality wins AND every output is bit-identical to
+    ``np.sort``.  Fairness (p95 queue-wait ratio across tenants, from
+    the controller journal's ``job_dequeued`` records) must hold the
+    same 3x bound the PR 7 serving layer is tested to.
+    """
+    import dataclasses
+    import tempfile
+
+    import jax
+
+    from dsort_tpu.fleet.agent import FleetAgent
+    from dsort_tpu.fleet.controller import FleetController
+    from dsort_tpu.serve import SortService
+    from dsort_tpu.utils.events import EventLog
+
+    devs = jax.devices()
+    n_devs = cfg.mesh.num_workers or len(devs)
+    if n_devs < 2:
+        raise SystemExit(
+            "--fleet-mixed needs >= 2 devices (each agent owns half the "
+            "mesh); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    devs = devs[:n_devs]
+    half = max(n_devs // 2, 1)
+    n_small = max(min(args.n, 1 << 19), 1 << 10)
+    n_large = 1 << 20  # >= FLEET_SMALL_JOB_MAX: routes by size
+    tenants = ("acme", "blue", "coral")
+    rng = np.random.default_rng(0)
+    # Two repeat sizes x two rounds: repeat-size jobs are where locality
+    # routing must show its cache-hit advantage over random.
+    small_jobs = []
+    for rnd in range(2):
+        for j in range(4):
+            for t in tenants:
+                n = n_small if j % 2 == 0 else max(n_small // 2, 1 << 9)
+                small_jobs.append(
+                    (t, rng.integers(0, 1 << 30, n).astype(np.int32))
+                )
+    large = rng.integers(0, 1 << 30, n_large).astype(np.int32)
+    serve_cfg = dataclasses.replace(
+        cfg.serve,
+        max_queue_depth=max(cfg.serve.max_queue_depth, len(small_jobs) + 8),
+        max_tenant_inflight=max(
+            cfg.serve.max_tenant_inflight, len(small_jobs) + 2
+        ),
+    )
+    journal = _open_journal(args) or EventLog()
+
+    def run_arm(routing: str, arm_journal, td: str):
+        agents = [
+            FleetAgent(
+                service=SortService(
+                    devices=devs[:half], job=cfg.job, serve=serve_cfg
+                ),
+                agent_id=f"{routing}-a",
+            ),
+            FleetAgent(
+                service=SortService(
+                    devices=devs[half:], job=cfg.job, serve=serve_cfg
+                ),
+                agent_id=f"{routing}-b",
+            ),
+        ]
+        ctl = FleetController(
+            [ag.addr for ag in agents],
+            state_dir=os.path.join(td, routing),
+            max_queue_depth=serve_cfg.max_queue_depth,
+            max_tenant_inflight=serve_cfg.max_tenant_inflight,
+            routing=routing,
+            heartbeat_s=0.5,
+            journal=arm_journal,
+        )
+        try:
+            t0 = time.perf_counter()
+            tickets = [
+                ctl.submit(d, tenant=t)[1] for t, d in small_jobs
+            ]
+            tickets.append(ctl.submit(large, tenant="acme")[1])
+            ok = True
+            for (t, d), ticket in zip(
+                small_jobs + [("acme", large)], tickets
+            ):
+                out = ticket.result(timeout=900)
+                ok = ok and bool(np.array_equal(out, np.sort(d)))
+            dt = time.perf_counter() - t0
+            rerouted = sum(
+                1 for e in arm_journal.events() if e.type == "job_rerouted"
+            )
+            hits = misses = 0
+            for ag in agents:
+                st = ag.service.variants.stats()
+                hits += st["hits"]
+                misses += st["misses"]
+            hit_rate = hits / (hits + misses) if (hits + misses) else 0.0
+            return dt, ok, hit_rate, rerouted
+        finally:
+            ctl.shutdown(drain=True)
+            for ag in agents:
+                ag.close()
+
+    rand_journal = EventLog()
+    with tempfile.TemporaryDirectory() as td:
+        dt_rand, ok_rand, hit_rand, _ = run_arm("random", rand_journal, td)
+        dt_loc, ok_loc, hit_loc, rerouted = run_arm("locality", journal, td)
+    try:
+        if getattr(args, "journal", None):
+            journal.flush_jsonl(args.journal)
+    except OSError as e:
+        log.warning("fleet-mixed journal write failed: %s", e)
+    p95, fairness = _queue_fairness(journal.events(), tenants)
+    ok = ok_rand and ok_loc and hit_loc > hit_rand
+    jobs_total = len(small_jobs) + 1
+    print(json.dumps({
+        "metric": "fleet_mixed_workload_2agents",
+        "value": round(jobs_total / dt_loc, 2),
+        "unit": "jobs/sec",
+        "jobs": jobs_total,
+        "tenants": len(tenants),
+        "agents": 2,
+        "cache_hit_rate": round(hit_loc, 3),
+        "cache_hit_rate_random": round(hit_rand, 3),
+        "p95_queue_wait_ms": round(p95 * 1e3, 2),
+        "fairness_p95_ratio": round(fairness, 2),
+        "speedup_vs_random": round(dt_rand / dt_loc, 2),
+        "rerouted": rerouted,
+        "bit_identical": ok_rand and ok_loc,
+    }), flush=True)
+    return 0 if ok else 1
+
+
 def cmd_bench(args) -> int:
     from dsort_tpu.data.ingest import gen_uniform
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if getattr(args, "fleet_mixed", False):
+        if args.suite or getattr(args, "device_resident", False) or getattr(
+            args, "exchange_ab", False
+        ) or getattr(args, "serve_mixed", False) or getattr(
+            args, "analyze_smoke", False
+        ) or getattr(args, "external_wave", False):
+            raise SystemExit(
+                "--fleet-mixed is its own benchmark: run it as a separate "
+                "invocation"
+            )
+        return _bench_fleet_mixed(args, _load_config(args))
     if getattr(args, "external_wave", False):
         if args.suite or getattr(args, "device_resident", False) or getattr(
             args, "exchange_ab", False
@@ -1833,10 +2141,23 @@ def cmd_report(args) -> int:
     """
     import json as _json
 
-    from dsort_tpu.obs.merge import group_rotated, merge_records, read_journal_set
+    from dsort_tpu.obs.merge import (
+        expand_path_args,
+        group_rotated,
+        merge_records,
+        read_journal_set,
+    )
     from dsort_tpu.utils.events import format_report, to_chrome_trace
 
-    sources = group_rotated(args.journal)
+    try:
+        # Fleet runs produce N journals per run: a positional arg may be a
+        # directory or glob of per-agent journals, expanded here before the
+        # rotation-set grouping (a rotated piece inside a directory still
+        # stitches into its base journal, never a phantom process).
+        paths = expand_path_args(args.journal)
+    except ValueError as e:
+        raise SystemExit(f"dsort report: {e}")
+    sources = group_rotated(paths)
     journals, skipped = [], 0
     for s in sources:
         recs, sk = read_journal_set(s)
@@ -1869,22 +2190,37 @@ def cmd_report(args) -> int:
 
 
 def cmd_top(args) -> int:
-    """One-shot (or ``--interval`` refreshing) console view of a metrics
-    endpoint scrape — the operator's `top` for a running ``dsort serve
-    --metrics-port`` session."""
-    from dsort_tpu.obs.top import fetch_metrics, render_top
+    """One-shot (or ``--interval`` refreshing) console view of metrics
+    endpoint scrape(s) — the operator's `top` for a running ``dsort serve
+    --metrics-port`` session, or, with SEVERAL URLs (the fleet
+    controller's endpoint plus one per agent), the per-mesh fleet view
+    with combined admissions/cache tables (ARCHITECTURE §12)."""
+    from dsort_tpu.obs.top import fetch_metrics, render_fleet, render_top
 
+    urls = args.url or ["http://127.0.0.1:9100/metrics"]
     shown = 0
     while True:
-        try:
-            parsed = fetch_metrics(args.url)
-        except (OSError, ValueError) as e:
-            log.error("scrape of %s failed: %s", args.url, e)
+        scrapes, unreachable = [], []
+        for url in urls:
+            try:
+                scrapes.append((url, fetch_metrics(url)))
+            except (OSError, ValueError) as e:
+                log.error("scrape of %s failed: %s", url, e)
+                unreachable.append(url)
+        if not scrapes:
             return 1
         if shown:
             print()  # separate refreshes; no terminal tricks needed
-        print(f"dsort top — {args.url}")
-        print(render_top(parsed), end="")
+        if len(urls) == 1:
+            print(f"dsort top — {urls[0]}")
+            print(render_top(scrapes[0][1]), end="")
+        else:
+            # A fleet view must render the REACHABLE meshes while one
+            # agent restarts — that is exactly when the operator looks.
+            print(f"dsort top — {len(scrapes)}/{len(urls)} sources")
+            print(render_fleet(scrapes), end="")
+            for url in unreachable:
+                print(f"  (unreachable: {url})")
         shown += 1
         if args.interval is None or (args.count and shown >= args.count):
             return 0
@@ -2095,6 +2431,71 @@ def main(argv=None) -> int:
                         "recovers automatically once the queue drains")
     p.set_defaults(fn=cmd_serve)
 
+    p = sub.add_parser(
+        "fleet-agent",
+        help="fleet execution agent: serve this process's mesh to a "
+             "`dsort fleet` controller (ARCHITECTURE §12)",
+    )
+    common(p)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address for the fleet protocol endpoint")
+    p.add_argument("--port", type=int, default=0,
+                   help="fleet protocol port (0 = ephemeral, printed at "
+                        "startup)")
+    p.add_argument("--agent-id",
+                   help="stable agent identity (default: generated); a "
+                        "restarted agent keeps its routing identity by "
+                        "reusing the id")
+    p.add_argument("--metrics-port", type=int,
+                   help="expose this mesh's live telemetry endpoint "
+                        "(render the whole fleet with `dsort top URL...`)")
+    p.add_argument("--prewarm", action="store_true",
+                   help="compile the capacity ladder's fused rungs at "
+                        "startup (advertised to the controller for "
+                        "locality routing)")
+    p.add_argument("--slice-devices", type=int,
+                   help="devices per small-job mesh sub-slice")
+    p.add_argument("--queue-limit", type=int,
+                   help="this agent's local queue bound")
+    p.add_argument("--tenant-limit", type=int,
+                   help="this agent's local per-tenant bound")
+    p.add_argument("--weights", help=argparse.SUPPRESS)
+    p.add_argument("--slo-shed-ms", type=float, help=argparse.SUPPRESS)
+    p.add_argument("--max-in-flight", type=int, help=argparse.SUPPRESS)
+    p.set_defaults(fn=cmd_fleet_agent)
+
+    p = sub.add_parser(
+        "fleet",
+        help="fleet controller REPL: route jobs over many mesh-owning "
+             "agents; restart-safe (ARCHITECTURE §12)",
+    )
+    common(p)
+    p.add_argument("--agents",
+                   help="agent endpoints host:port,host:port (conf "
+                        "FLEET_AGENTS)")
+    p.add_argument("--state-dir",
+                   help="persist the control-plane state here so a "
+                        "controller restart loses no job (conf "
+                        "FLEET_STATE_DIR)")
+    p.add_argument("--routing", choices=["locality", "random"],
+                   help="variant-cache-locality routing (default) or the "
+                        "random A/B baseline (conf FLEET_ROUTING)")
+    p.add_argument("--metrics-port", type=int,
+                   help="expose the controller's telemetry endpoint")
+    p.add_argument("--max-in-flight", type=int, default=1,
+                   help="REPL jobs in flight at once (like `dsort serve`)")
+    p.add_argument("--queue-limit", type=int,
+                   help="admission control: max jobs queued fleet-wide")
+    p.add_argument("--tenant-limit", type=int,
+                   help="admission control: max queued+running jobs per "
+                        "tenant")
+    p.add_argument("--weights",
+                   help="fair-scheduler tenant weights, e.g. acme=2,blue=1")
+    p.add_argument("--slo-shed-ms", type=float,
+                   help="admission shedding target (ms, per-tenant live "
+                        "p95 queue wait)")
+    p.set_defaults(fn=cmd_fleet)
+
     p = sub.add_parser("bench", help="throughput benchmark (one JSON line)")
     common(p)
     p.add_argument("--n", type=int, default=1 << 22)
@@ -2123,6 +2524,13 @@ def main(argv=None) -> int:
     p.add_argument("--memwatch", action="store_true",
                    help="snapshot device memory at phase boundaries into "
                         "hbm_watermark journal events")
+    p.add_argument("--fleet-mixed", action="store_true",
+                   help="federated serving benchmark: 2 local mesh-owning "
+                        "agents behind a fleet controller over real TCP, "
+                        "mixed tenants/sizes, locality-vs-random routing "
+                        "A/B; one JSON line with both fleet-wide variant-"
+                        "cache hit rates, fairness ratio and bit-identical "
+                        "outputs")
     p.add_argument("--external-wave", action="store_true",
                    help="out-of-core wave-pipeline benchmark: sort a "
                         "dataset 8x the per-wave device budget through the "
@@ -2234,7 +2642,9 @@ def main(argv=None) -> int:
     )
     p.add_argument("journal", nargs="+",
                    help="journal JSONL(s) from `--journal`; several merge "
-                        "into one clock-aligned fleet timeline")
+                        "into one clock-aligned fleet timeline; a "
+                        "directory or glob expands to the journals inside "
+                        "(fleet runs write one per agent)")
     p.add_argument("--merge", action="store_true",
                    help="merge the journals into one aligned trace "
                         "(implied when more than one is given)")
@@ -2255,11 +2665,13 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser(
-        "top", help="console view of a running serve's metrics endpoint"
+        "top", help="console view of running metrics endpoint(s) "
+                    "(several URLs = the per-mesh fleet view)"
     )
-    p.add_argument("url", nargs="?",
-                   default="http://127.0.0.1:9100/metrics",
-                   help="metrics endpoint URL (default %(default)s)")
+    p.add_argument("url", nargs="*",
+                   help="metrics endpoint URL(s) (default "
+                        "http://127.0.0.1:9100/metrics; several render the "
+                        "fleet view with combined admissions/cache tables)")
     p.add_argument("--interval", type=float,
                    help="refresh every N seconds (default: one-shot)")
     p.add_argument("--count", type=int,

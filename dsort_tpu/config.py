@@ -312,6 +312,44 @@ class ExternalConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet-plane knobs (`dsort fleet` / `dsort fleet-agent`;
+    ARCHITECTURE §12).
+
+    ``agents`` is the controller's agent endpoint list (flag ``--agents
+    host:port,...`` / conf ``FLEET_AGENTS``); ``state_dir`` is where the
+    controller persists its restart-safe queue + job table
+    (``FLEET_STATE_DIR`` — without it a restart loses queued jobs);
+    ``routing`` picks variant-cache-locality routing or the random A/B
+    baseline (``FLEET_ROUTING``); ``heartbeat_s`` paces the controller's
+    agent pings (``FLEET_HEARTBEAT_S``).
+    """
+
+    agents: tuple[str, ...] = ()
+    state_dir: str | None = None
+    routing: str = "locality"
+    heartbeat_s: float = 2.0
+
+    def __post_init__(self) -> None:
+        from dsort_tpu.fleet.proto import ROUTING_POLICIES
+
+        if self.routing not in ROUTING_POLICIES:
+            raise ConfigError(
+                f"routing must be one of {ROUTING_POLICIES}, got "
+                f"{self.routing!r}"
+            )
+        if self.heartbeat_s <= 0:
+            raise ConfigError(
+                f"heartbeat_s must be > 0, got {self.heartbeat_s}"
+            )
+        for a in self.agents:
+            if ":" not in str(a):
+                raise ConfigError(
+                    f"agent address {a!r} must be HOST:PORT"
+                )
+
+
+@dataclasses.dataclass(frozen=True)
 class SortConfig:
     """Top-level framework config: mesh + job + control-plane endpoints."""
 
@@ -319,6 +357,7 @@ class SortConfig:
     job: JobConfig = dataclasses.field(default_factory=JobConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     external: ExternalConfig = dataclasses.field(default_factory=ExternalConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     # Control-plane endpoint (native coordinator; reference server.conf parity).
     server_ip: str = "127.0.0.1"
     server_port: int = 9008        # reference default, server.conf:1
@@ -338,7 +377,9 @@ class SortConfig:
         ``SERVE_WEIGHTS`` — ``tenant=weight,...`` — ``SERVE_PREWARM``,
         and ``SERVE_SLO_SHED_MS``) and out-of-core keys
         (``EXTERNAL_RUN_ELEMS``, ``EXTERNAL_WAVE_ELEMS``,
-        ``EXTERNAL_MESH``).
+        ``EXTERNAL_MESH``) and fleet-plane keys (``FLEET_AGENTS`` —
+        ``host:port,host:port`` — ``FLEET_STATE_DIR``, ``FLEET_ROUTING``,
+        ``FLEET_HEARTBEAT_S``).
         """
         def geti(key: str, default: int | None) -> int | None:
             return int(m[key]) if key in m else default
@@ -388,11 +429,23 @@ class SortConfig:
             wave_elems=geti("EXTERNAL_WAVE_ELEMS", ExternalConfig.wave_elems),
             mesh=geti("EXTERNAL_MESH", None),
         )
+        fleet = FleetConfig(
+            agents=tuple(
+                a.strip() for a in m.get("FLEET_AGENTS", "").split(",")
+                if a.strip()
+            ),
+            state_dir=m.get("FLEET_STATE_DIR") or None,
+            routing=m.get("FLEET_ROUTING", FleetConfig.routing),
+            heartbeat_s=float(
+                m.get("FLEET_HEARTBEAT_S", FleetConfig.heartbeat_s)
+            ),
+        )
         return cls(
             mesh=mesh,
             job=job,
             serve=serve,
             external=external,
+            fleet=fleet,
             server_ip=m.get("SERVER_IP", "127.0.0.1"),
             server_port=int(m.get("SERVER_PORT", 9008)),
             output_path=m.get("OUTPUT_PATH", "output.txt"),

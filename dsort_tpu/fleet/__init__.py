@@ -1,0 +1,44 @@
+"""Federated fleet serving: a cross-process control plane over many
+mesh-owning agents (ISSUE 12; ARCHITECTURE §12).
+
+The §12 split of the serving stack: `controller` (pure control plane —
+admission, weighted-DRR fairness, SLO shedding, routing, restart-safe
+persistence; NO backend imports) routes jobs over `agent` processes (each
+wrapping a `serve.SortService` that owns one mesh or mesh slice), speaking
+framed JSON over TCP (`proto`).  Exoshuffle (arXiv:2301.03734) is the
+blueprint — shuffle as a library under a thin control plane — and the
+mesh-availability framing of arXiv:2011.03605 motivates routing around
+draining/re-forming meshes instead of blocking on them.
+
+Import layering: `proto` and `controller` stay backend-free (the fleet
+controller runs in a process that never initializes JAX — test-enforced);
+`agent` pulls the backend and is therefore exported lazily.
+"""
+
+from dsort_tpu.fleet.proto import (  # noqa: F401
+    FLEET_SMALL_JOB_MAX,
+    FRAME_TYPES,
+    ProtocolError,
+    fused_rung,
+    parse_agent_addrs,
+)
+from dsort_tpu.fleet.controller import (  # noqa: F401
+    ControllerClosed,
+    FleetController,
+    FleetTicket,
+    ROUTING_POLICIES,
+)
+
+_AGENT_NAMES = ("FleetAgent",)
+
+
+def __getattr__(name):  # PEP 562: the agent side imports the backend
+    if name in _AGENT_NAMES:
+        from dsort_tpu.fleet import agent
+
+        return getattr(agent, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_AGENT_NAMES))

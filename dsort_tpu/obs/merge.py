@@ -111,6 +111,53 @@ def merge_journals(paths: list[str]) -> tuple[list[dict], int]:
     return merge_records(journals), skipped
 
 
+# -- CLI path expansion (fleet runs produce N journals per run) --------------
+
+
+def expand_path_args(paths: list[str]) -> list[str]:
+    """``dsort report`` positional args -> concrete journal paths.
+
+    Each arg may be a file, a DIRECTORY (expands to its ``*.jsonl`` files
+    plus their rotation pieces, sorted), or a GLOB pattern (``fleet/
+    *.jsonl`` — expanded with `glob.glob`, sorted).  A directory or
+    pattern that matches nothing is a loud error: a typo'd fleet-trace
+    merge must never silently render one journal as the whole fleet.
+    Plain files pass through untouched (including not-yet-existing paths —
+    the reader reports those).  Order: args in given order, matches sorted
+    within each arg, so `group_rotated` downstream still collapses
+    rotation sets.
+    """
+    import glob as _glob
+
+    out: list[str] = []
+    for p in paths:
+        p = str(p)
+        if os.path.isdir(p):
+            matches = sorted(
+                e for e in _glob.glob(os.path.join(p, "*.jsonl*"))
+                if os.path.isfile(e)
+            )
+            if not matches:
+                raise ValueError(f"directory {p!r} contains no *.jsonl journals")
+            out.extend(matches)
+        elif _glob.has_magic(p):
+            matches = sorted(e for e in _glob.glob(p) if os.path.isfile(e))
+            if not matches:
+                raise ValueError(f"glob {p!r} matched no journal files")
+            out.extend(matches)
+        else:
+            out.append(p)
+    # One journal mentioned by two args (a glob overlapping a file arg)
+    # must not merge with itself as a phantom second process.
+    seen: set[str] = set()
+    unique = []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            unique.append(p)
+    return unique
+
+
 # -- rotated journal sets (--journal-rotate-mb) ------------------------------
 
 _ROTATED = re.compile(r"^(?P<base>.+)\.(?P<n>\d+)$")

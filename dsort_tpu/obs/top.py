@@ -1,10 +1,14 @@
-"""``dsort top``: render one metrics scrape as a console snapshot.
+"""``dsort top``: render metrics scrape(s) as a console snapshot.
 
-Scrapes the `obs.server` endpoint (stdlib urllib), parses the Prometheus
-text through the same minimal parser the tier-1 gate uses, and renders the
-operator view: jobs in flight / queue depth, per-tenant job outcomes and
-SLO stage quantiles, phase wall time, and the nonzero counters.  One-shot
-by default; ``--interval`` refreshes until Ctrl-C.
+Scrapes one or more `obs.server` endpoints (stdlib urllib), parses the
+Prometheus text through the same minimal parser the tier-1 gate uses, and
+renders the operator view: jobs in flight / queue depth, per-tenant job
+outcomes and SLO stage quantiles, phase wall time, and the nonzero
+counters.  With SEVERAL URLs (a fleet run: the controller's endpoint plus
+one per agent, ARCHITECTURE §12) `render_fleet` shows a per-mesh summary
+row for each source plus COMBINED admissions and variant-cache tables
+summed across the fleet.  One-shot by default; ``--interval`` refreshes
+until Ctrl-C.
 """
 
 from __future__ import annotations
@@ -124,4 +128,80 @@ def render_top(parsed: dict) -> str:
         lines.append("counters (nonzero):")
         for name, value in counters:
             lines.append(f"  {name:<28} {int(value):>10}")
+    return "\n".join(lines) + "\n"
+
+
+# -- fleet view (several endpoints at once, ARCHITECTURE §12) ----------------
+
+
+def _cache_cells(parsed: dict) -> tuple[float, float, int, int]:
+    hits = parsed.get(("dsort_variant_cache_hits", ()), 0.0)
+    misses = parsed.get(("dsort_variant_cache_misses", ()), 0.0)
+    entries = int(parsed.get(("dsort_variant_cache_entries", ()), 0.0))
+    prewarmed = int(parsed.get(("dsort_variant_cache_prewarmed", ()), 0.0))
+    return hits, misses, entries, prewarmed
+
+
+def render_fleet(scrapes: list[tuple[str, dict]]) -> str:
+    """The per-mesh fleet view for several parsed scrapes.
+
+    One summary row per source (its URL, jobs in flight, queue depth,
+    done/failed totals, cache hit rate) followed by the COMBINED
+    admissions table and the combined variant-cache line (fleet hit rate
+    = total hits / total lookups).  When a fleet CONTROLLER is among the
+    sources (it exposes the ``dsort_fleet_agents`` gauge), the
+    admissions table sums controllers only — every routed job is admitted
+    a second time by its agent's local service, and summing both layers
+    would double-count the fleet's real backpressure.
+    """
+    controller_urls = {
+        url for url, parsed in scrapes
+        if ("dsort_fleet_agents", ()) in parsed
+    }
+    lines = ["fleet:"]
+    lines.append(
+        f"  {'source':<40}{'in-flight':>10}{'queued':>8}{'done':>8}"
+        f"{'failed':>8}{'hit rate':>10}"
+    )
+    tot_hits = tot_misses = tot_entries = tot_prewarmed = 0
+    admissions: dict[tuple[str, str], int] = {}
+    for url, parsed in scrapes:
+        in_flight = int(parsed.get(("dsort_jobs_in_flight", ()), 0.0))
+        queued = int(parsed.get(("dsort_queue_depth", ()), 0.0))
+        done = failed = 0
+        for labels, value in _labeled(parsed, "dsort_jobs_total"):
+            if labels.get("outcome") == "done":
+                done += int(value)
+            elif labels.get("outcome") == "failed":
+                failed += int(value)
+        hits, misses, entries, prewarmed = _cache_cells(parsed)
+        tot_hits += hits
+        tot_misses += misses
+        tot_entries += entries
+        tot_prewarmed += prewarmed
+        rate = hits / (hits + misses) if (hits + misses) else 0.0
+        agents = parsed.get(("dsort_fleet_agents", ()))
+        tag = f" [{int(agents)} agents]" if agents is not None else ""
+        lines.append(
+            f"  {(url + tag)[:40]:<40}{in_flight:>10}{queued:>8}{done:>8}"
+            f"{failed:>8}{rate * 100:>9.1f}%"
+        )
+        if controller_urls and url not in controller_urls:
+            continue  # agent-local admissions mirror the controller's
+        for labels, value in _labeled(parsed, "dsort_admissions_total"):
+            key = (labels.get("tenant", "?"), labels.get("reason", "?"))
+            admissions[key] = admissions.get(key, 0) + int(value)
+    if admissions:
+        lines.append("admissions (fleet-wide):")
+        for (tenant, reason) in sorted(admissions):
+            lines.append(
+                f"  {tenant:<16} {reason:<14} {admissions[(tenant, reason)]:>8}"
+            )
+    total = tot_hits + tot_misses
+    lines.append(
+        f"variant cache (combined): {tot_entries} entries    hits "
+        f"{int(tot_hits)}  misses {int(tot_misses)}  prewarmed "
+        f"{tot_prewarmed}  hit rate "
+        f"{(tot_hits / total if total else 0.0) * 100:.1f}%"
+    )
     return "\n".join(lines) + "\n"

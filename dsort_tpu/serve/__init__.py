@@ -1,4 +1,4 @@
-"""Multi-tenant serving layer (ROADMAP item 1; ARCHITECTURE §8).
+"""Multi-tenant serving layer (ROADMAP item 1; ARCHITECTURE §8, §12).
 
 The event-driven successor of the reference's blocking job REPL
 (``server.c:160-167``): jobs are *submitted* (non-blocking) through typed
@@ -9,6 +9,13 @@ mesh through the SPMD scheduler — with the compiled-variant cache keyed on
 the capacity ladder so repeat-size jobs never recompile.  Exoshuffle
 (arXiv:2301.03734) is the blueprint: sorting as an application-level
 library over a shared futures runtime rather than a job-at-a-time binary.
+
+Import layering (the §12 split): `admission`, `fair`, `policy` and
+`variants` are PURE (stdlib + numpy, no backend) so the fleet controller —
+a process that never owns a mesh — can import the control plane without
+initializing JAX.  `service` (the in-process execution side) pulls the
+backend; it is imported lazily here so ``from dsort_tpu.serve import
+ControlPolicy`` stays backend-free.
 """
 
 from dsort_tpu.serve.admission import (  # noqa: F401
@@ -17,9 +24,19 @@ from dsort_tpu.serve.admission import (  # noqa: F401
     AdmissionController,
 )
 from dsort_tpu.serve.fair import DeficitRoundRobin, parse_weights  # noqa: F401
+from dsort_tpu.serve.policy import ControlPolicy  # noqa: F401
 from dsort_tpu.serve.variants import VariantCache, fused_variant_key  # noqa: F401
-from dsort_tpu.serve.service import (  # noqa: F401
-    JobTicket,
-    ServiceClosed,
-    SortService,
-)
+
+_SERVICE_NAMES = ("JobTicket", "ServiceClosed", "SortService")
+
+
+def __getattr__(name):  # PEP 562: lazy, so the control plane stays pure
+    if name in _SERVICE_NAMES:
+        from dsort_tpu.serve import service
+
+        return getattr(service, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SERVICE_NAMES))

@@ -18,6 +18,9 @@ import dataclasses
 #: The full verdict vocabulary, journal- and test-enforced (ARCHITECTURE §8).
 ADMISSION_REASONS = (
     "admitted",        # accepted: the job is queued for dispatch
+    "no_capacity",     # fleet plane (§12): every execution agent is draining
+                       # or gone — backing off cannot help until an agent
+                       # returns, so this outranks the queue bounds
     "queue_full",      # global queue-depth limit reached (back off, retry)
     "tenant_limit",    # this tenant's in-flight limit reached (tenant backs off)
     "shutting_down",   # the service is draining; no new work is accepted
@@ -77,19 +80,25 @@ class AdmissionController:
         return self._tenant_inflight.get(tenant, 0)
 
     def consider(
-        self, tenant: str, shutting_down: bool, shed: bool = False
+        self, tenant: str, shutting_down: bool, shed: bool = False,
+        no_capacity: bool = False,
     ) -> Admission:
         """The verdict for one submission; an admitted job is counted.
 
         ``shed`` is the SLO-driven signal the service computes (live p95
         queue wait over target with work still queued); it ranks below the
         hard bounds — a full queue is still ``queue_full``, the more
-        actionable verdict for a backing-off client.
+        actionable verdict for a backing-off client.  ``no_capacity`` is
+        the fleet controller's signal that every execution agent is
+        draining or dead; it outranks the queue bounds (a client retry is
+        pointless until an agent returns) but not ``shutting_down``.
         """
         depth = self.queue_depth
         t_depth = self.tenant_inflight(tenant)
         if shutting_down:
             reason = "shutting_down"
+        elif no_capacity:
+            reason = "no_capacity"
         elif depth >= self.max_queue_depth:
             reason = "queue_full"
         elif t_depth >= self.max_tenant_inflight:
@@ -118,3 +127,20 @@ class AdmissionController:
             self._tenant_inflight[tenant] = left
         else:
             self._tenant_inflight.pop(tenant, None)
+
+    # -- serialization (the fleet controller's restart contract, §12) --------
+
+    def state_dict(self) -> dict:
+        """JSON-able snapshot of the admission counts."""
+        return {
+            "queue_depth": int(self.queue_depth),
+            "tenant_inflight": dict(self._tenant_inflight),
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.queue_depth = int(state.get("queue_depth", 0))
+        self._tenant_inflight = {
+            str(t): int(n)
+            for t, n in dict(state.get("tenant_inflight", {})).items()
+            if int(n) > 0
+        }

@@ -99,3 +99,43 @@ class DeficitRoundRobin:
             self._deficit[tenant] += self.quantum * self.weight_of(tenant)
             self._rotation.rotate(-1)
         return None
+
+    # -- serialization (the fleet controller's restart contract, §12) --------
+
+    def state_dict(self, token_fn=None) -> dict:
+        """JSON-able snapshot: queues (in FIFO order), deficits, rotation.
+
+        ``token_fn`` maps each queued item to its serialized form (the
+        fleet controller stores job ids; the default assumes the items
+        already are JSON-able).  Restoring through `load_state` preserves
+        the exact DRR dispatch order — the restart drill's contract.
+        """
+        fn = token_fn or (lambda item: item)
+        return {
+            "queues": {
+                t: [[int(c), fn(item)] for c, item in q]
+                for t, q in self._queues.items() if q
+            },
+            "deficit": {t: float(d) for t, d in self._deficit.items()},
+            "rotation": [t for t in self._rotation],
+        }
+
+    def load_state(self, state: dict, token_fn=None) -> None:
+        fn = token_fn or (lambda tok: tok)
+        self._queues = {
+            str(t): deque((int(c), fn(tok)) for c, tok in q)
+            for t, q in dict(state.get("queues", {})).items()
+        }
+        self._deficit = {
+            str(t): float(d)
+            for t, d in dict(state.get("deficit", {})).items()
+            if t in self._queues
+        }
+        # Rotation keeps the persisted visit order; tenants that appeared
+        # in the queues but not the rotation (shouldn't happen) append at
+        # the end so no queued job is ever stranded.
+        rot = [t for t in state.get("rotation", ()) if self._queues.get(t)]
+        rot += [t for t in self._queues if t not in rot and self._queues[t]]
+        self._rotation = deque(rot)
+        for t in self._rotation:
+            self._deficit.setdefault(t, 0.0)

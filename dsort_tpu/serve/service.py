@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -49,8 +48,8 @@ from dsort_tpu.scheduler.fault import (
     WorkerFailure,
     classify_runtime_error,
 )
-from dsort_tpu.serve.admission import Admission, AdmissionController
-from dsort_tpu.serve.fair import DeficitRoundRobin
+from dsort_tpu.serve.admission import Admission
+from dsort_tpu.serve.policy import ControlPolicy
 from dsort_tpu.serve.variants import VariantCache, fused_variant_key, spmd_variant_key
 from dsort_tpu.utils.logging import get_logger
 from dsort_tpu.utils.metrics import Metrics, PhaseTimer
@@ -122,21 +121,19 @@ class SortService:
         self._closed = False
         self._done_jobs = 0
         self._failed_jobs = 0
-        self._admission = AdmissionController(
-            self.serve.max_queue_depth, self.serve.max_tenant_inflight
-        )
-        self._drr = DeficitRoundRobin(
-            quantum=self.serve.drr_quantum_keys,
-            weights=dict(self.serve.tenant_weights),
+        # THE control plane (serve.policy): admission + weighted DRR + SLO
+        # shedding as one serializable, backend-free state machine — the
+        # same object the fleet controller (§12) runs cross-process.
+        # Driven under self._cv throughout.
+        self._policy = ControlPolicy(
+            max_queue_depth=self.serve.max_queue_depth,
+            max_tenant_inflight=self.serve.max_tenant_inflight,
+            drr_quantum_keys=self.serve.drr_quantum_keys,
+            tenant_weights=dict(self.serve.tenant_weights),
+            slo_shed_ms=self.serve.slo_shed_ms,
         )
         self.variants = VariantCache(self.serve.variant_cache_entries)
         self._inflight: dict = {}  # ticket -> allocated slice ids
-        # SLO-driven shedding (--slo-shed-ms): a sliding window of recent
-        # MEASURED queue waits per tenant.  A bounded deque — not the
-        # cumulative SLO histogram — so the signal decays: once the queue
-        # drains, new near-zero waits displace the congested ones and
-        # admission recovers (the drill the shed contract requires).
-        self._recent_waits: dict[str, deque] = {}
         if runner is None:
             import jax
 
@@ -233,9 +230,8 @@ class SortService:
         """
         data = np.asarray(data)
         tenant = tenant or self.job.tenant
-        shed = self._should_shed(tenant)
         with self._cv:
-            verdict = self._admission.consider(tenant, self._shutdown, shed)
+            verdict = self._policy.consider(tenant, self._shutdown)
         if self.telemetry is not None:
             self.telemetry.admission_verdict(tenant, verdict.reason)
         if not verdict.admitted:
@@ -269,7 +265,7 @@ class SortService:
             tenant=tenant,
         )
         with self._cv:
-            self._drr.push(tenant, max(len(data), 1), ticket)
+            self._policy.push(tenant, max(len(data), 1), ticket)
             self._cv.notify_all()
         self._publish_gauges()
         return verdict, ticket
@@ -297,9 +293,8 @@ class SortService:
             with self._cv:
                 nxt = None
                 while nxt is None:
-                    nxt = self._drr.pop()
+                    nxt = self._policy.pop()
                     if nxt is not None:
-                        self._admission.dequeued()
                         break
                     # Drain-exit only when nothing is queued, in flight, OR
                     # admitted-but-not-yet-pushed: submit() counts the job
@@ -309,7 +304,7 @@ class SortService:
                     if (
                         self._shutdown
                         and not self._inflight
-                        and self._admission.queue_depth == 0
+                        and self._policy.queue_depth == 0
                     ):
                         return
                     self._cv.wait(timeout=0.05)
@@ -342,30 +337,10 @@ class SortService:
             )
             self._pool.submit(self._execute, ticket, alloc, big)
 
-    # -- SLO-driven shedding (ROADMAP item 1 remainder) ---------------------
-
     def _note_wait(self, tenant: str, wait_s: float) -> None:
-        dq = self._recent_waits.get(tenant)
-        if dq is None:
-            dq = self._recent_waits[tenant] = deque(maxlen=32)
-        dq.append(float(wait_s))
-
-    def _should_shed(self, tenant: str) -> bool:
-        """``--slo-shed-ms``: live p95 of this tenant's recent measured
-        queue waits over target WHILE work is queued.  The queued-work
-        gate is what makes the verdict self-healing: an empty queue means
-        a new job would wait ~0, so it is always admitted — and its
-        near-zero wait then washes the congested window out."""
-        target_ms = self.serve.slo_shed_ms
-        if not target_ms:
-            return False
+        # SLO-shed windows (--slo-shed-ms) live in the control plane now.
         with self._cv:
-            if self._admission.queue_depth <= 0:
-                return False
-        waits = list(self._recent_waits.get(tenant) or ())
-        if not waits:
-            return False
-        return float(np.percentile(waits, 95)) * 1e3 > target_ms
+            self._policy.note_wait(tenant, wait_s)
 
     # -- execution ----------------------------------------------------------
 
@@ -498,8 +473,7 @@ class SortService:
         # evicted job is in neither set and the drain exits without it.
         ticket.queued_mono = time.monotonic()
         with self._cv:
-            self._admission.requeued()
-            self._drr.push(ticket.tenant, max(ticket.n_keys, 1), ticket)
+            self._policy.requeue(ticket.tenant, max(ticket.n_keys, 1), ticket)
             self._cv.notify_all()
         self._release(ticket, alloc, probe=True)
         self._publish_gauges()
@@ -578,7 +552,7 @@ class SortService:
         ticket.metrics.event("result_fetch", n_keys=len(out))
         self._release(ticket, alloc)
         with self._cv:
-            self._admission.finished(ticket.tenant)
+            self._policy.finished(ticket.tenant)
             self._done_jobs += 1
         ticket.data = None  # a long session must not pin every input array
         ticket._result = out
@@ -597,7 +571,7 @@ class SortService:
         )
         self._release(ticket, alloc, probe=True)
         with self._cv:
-            self._admission.finished(ticket.tenant)
+            self._policy.finished(ticket.tenant)
             self._failed_jobs += 1
         ticket._error = e
         ticket._done.set()
@@ -668,7 +642,7 @@ class SortService:
             return
         stats = self.variants.stats()
         with self._cv:
-            depth = self._admission.queue_depth
+            depth = self._policy.queue_depth
             free = len(self._free)
         self.telemetry.set_gauge("queue_depth", depth)
         self.telemetry.set_gauge("slices_free", free)
@@ -683,7 +657,7 @@ class SortService:
             "slices": {str(k): [d.id for d in v] for k, v in self._slices.items()
                        if v is not None},
             "free": sorted(self._free),
-            "queued": self._admission.queue_depth,
+            "queued": self._policy.queue_depth,
             "in_flight": len(self._inflight),
         }
 
@@ -696,12 +670,12 @@ class SortService:
 
     def queue_depth(self) -> int:
         with self._cv:
-            return self._admission.queue_depth
+            return self._policy.queue_depth
 
     def stats(self) -> dict:
         with self._cv:
             return {
-                "queued": self._admission.queue_depth,
+                "queued": self._policy.queue_depth,
                 "in_flight": len(self._inflight),
                 "done": self._done_jobs,
                 "failed": self._failed_jobs,
@@ -727,13 +701,12 @@ class SortService:
                 return True
             first = not self._shutdown
             self._shutdown = True
-            queued, in_flight = len(self._drr), len(self._inflight)
+            queued, in_flight = self._policy.queued, len(self._inflight)
             if not drain:
                 while True:
-                    nxt = self._drr.pop()
+                    nxt = self._policy.pop()
                     if nxt is None:
                         break
-                    self._admission.dequeued()
                     dropped.append(nxt[1])
             self._cv.notify_all()
         if first:

@@ -65,6 +65,12 @@ class VariantCache:
         with self._lock:
             return len(self._entries)
 
+    def keys(self) -> list[tuple]:
+        """Snapshot of the cached variant keys (LRU order, oldest first) —
+        what a fleet agent advertises for locality routing (§12)."""
+        with self._lock:
+            return list(self._entries)
+
     def stats(self) -> dict:
         with self._lock:
             return {

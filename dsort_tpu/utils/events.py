@@ -112,6 +112,21 @@ EVENT_TYPES: dict[str, str] = {
     "fused_exchange_step": "one planned in-kernel async-remote-copy step of "
                            "the fused ring (step, cap, bytes) — the fused "
                            "twin of exchange_step",
+    # Fleet plane (dsort_tpu.fleet, ARCHITECTURE §12):
+    "agent_register": "a fleet execution agent (re)registered with the "
+                      "controller (agent, addr, capacity, big_jobs, "
+                      "draining, variants, reattach)",
+    "agent_heartbeat": "one controller->agent heartbeat round-trip (agent, "
+                       "queued, in_flight, draining, variants)",
+    "job_routed": "the fleet controller dispatched a job onto an agent "
+                  "(job_id, tenant, agent, reason — locality/size/spill/"
+                  "random, n_keys)",
+    "job_rerouted": "a routed/in-flight job re-entered the fleet queue "
+                    "after its agent drained, died, or forgot it (job_id, "
+                    "tenant, frm, reason, readmits)",
+    "controller_restore": "a restarted fleet controller restored its "
+                          "persisted queue + in-flight state (controller, "
+                          "queued, inflight, agents)",
     # Out-of-core wave pipeline (models.wave_sort, ARCHITECTURE §10):
     "wave_start": "one input wave entered the mesh pipeline "
                   "(wave, n_keys)",
@@ -180,6 +195,13 @@ COUNTERS: dict[str, str] = {
                                "P-1 per-step exchange dispatches)",
     "fused_exchange_steps": "async-remote-copy steps executed inside fused "
                             "ring kernel launches",
+    "fleet_jobs_routed": "jobs the fleet controller dispatched onto "
+                         "execution agents",
+    "fleet_jobs_rerouted": "routed jobs re-queued after an agent drained, "
+                           "died, or forgot them",
+    "fleet_heartbeats": "controller->agent heartbeat round-trips completed",
+    "controller_restores": "fleet controller restarts that restored "
+                           "persisted queue/in-flight state",
     "waves_sorted": "input waves run through the mesh exchange pipeline",
     "wave_runs_resorted": "(wave, run) store entries re-sorted by the "
                           "run-granular resume/repair path",
