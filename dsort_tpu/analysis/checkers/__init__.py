@@ -1,7 +1,8 @@
 """Checker registry: every project-native rule, one instance each.
 
 Adding a checker = adding a class with ``name``/``codes``/``scope``/``check``
-and listing it here; the engine, CLI, docs catalog and the lint tests pick
+(or ``project = True`` + ``check_project`` for a cross-file rule) and
+listing it here; the engine, CLI, docs catalog and the lint tests pick
 it up from this one function.
 """
 
@@ -9,7 +10,11 @@ from __future__ import annotations
 
 from dsort_tpu.analysis.checkers.compat import CompatChecker
 from dsort_tpu.analysis.checkers.concurrency import ConcurrencyChecker
+from dsort_tpu.analysis.checkers.durability import DurabilityChecker
 from dsort_tpu.analysis.checkers.exceptions import ExceptionsChecker
+from dsort_tpu.analysis.checkers.layers import LayersChecker
+from dsort_tpu.analysis.checkers.lifecycle import LifecycleChecker
+from dsort_tpu.analysis.checkers.protocol import ProtocolChecker
 from dsort_tpu.analysis.checkers.registry import RegistryChecker
 from dsort_tpu.analysis.checkers.tracing import TracingChecker
 
@@ -21,6 +26,10 @@ def all_checkers():
         TracingChecker(),
         ExceptionsChecker(),
         CompatChecker(),
+        LayersChecker(),
+        DurabilityChecker(),
+        ProtocolChecker(),
+        LifecycleChecker(),
     ]
 
 

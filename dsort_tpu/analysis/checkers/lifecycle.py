@@ -1,0 +1,216 @@
+"""Kernel/thread lifecycle checker: DMA pairing and thread discipline.
+
+The fused ring kernel (`ops/ring_kernel.py`) lives on one invariant the
+tracing checker cannot see: every `pltpu.make_async_remote_copy` that is
+``.start()``ed must be drained — ``.wait()``, or BOTH ``.wait_recv()``
+(the data landed) and ``.wait_send()`` (the source buffer may be reused)
+— before the kernel returns or overwrites the buffers the DMA touches.
+A missing wait is not a crash at trace time; it is silent corruption on
+real ICI, the worst possible failure mode.  The fleet/serve/obs planes
+added a second lifecycle surface: threads.  A non-daemon thread that is
+never joined outlives its owner and blocks interpreter exit — the shape
+that turns a clean ``dsort fleet`` Ctrl-C into a hang.
+
+Codes
+  DS901  an async remote copy is started but never waited in the same
+         function: the DMA may still be in flight when the kernel
+         completes
+  DS902  an async remote copy drains only one direction (``wait_recv``
+         without ``wait_send``, or vice versa) and never calls plain
+         ``wait()``: the un-drained side races buffer reuse
+  DS903  a ``threading.Thread`` is created without ``daemon=True`` and
+         never ``.join()``ed anywhere in the module: it outlives its
+         owner and blocks interpreter exit
+
+Pairing is per enclosing function and per copy *factory*: the ring
+kernels build copies through a local ``def copy(k): return
+pltpu.make_async_remote_copy(...)`` — ``copy(k).start()`` pairs with
+``copy(j).wait_recv()``/``copy(j).wait_send()`` on the same factory.
+Direct ``make_async_remote_copy(...).start()`` chains and simple local
+bindings (``c = make_async_remote_copy(...)``) resolve the same way.
+Join detection for DS903 is module-wide by target name (threads are
+often created in ``__init__`` and joined in ``shutdown``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dsort_tpu.analysis.astutil import callee_basename as _callee_basename
+from dsort_tpu.analysis.astutil import own_nodes as _own_nodes
+from dsort_tpu.analysis.core import Diagnostic
+from dsort_tpu.analysis.engine import Checker, FileContext
+
+_DMA_FACTORY = "make_async_remote_copy"
+_WAIT_ATTRS = {"wait", "wait_recv", "wait_send"}
+
+
+class LifecycleChecker(Checker):
+    name = "lifecycle"
+    codes = {
+        "DS901": "async remote copy started but never waited",
+        "DS902": "async remote copy drains only one DMA direction",
+        "DS903": "non-daemon thread never joined",
+    }
+    scope = ("*.py",)
+
+    def check(self, ctx: FileContext) -> list[Diagnostic]:
+        diags: list[Diagnostic] = []
+        fns = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in fns:
+            diags.extend(self._check_dma(ctx, fn))
+        diags.extend(self._check_threads(ctx, fns))
+        return diags
+
+    # -- DS901 / DS902 -------------------------------------------------------
+
+    @staticmethod
+    def _dma_factories(fn) -> set[str]:
+        """Names of local functions that return a make_async_remote_copy."""
+        out = set()
+        for node in fn.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Return)
+                    and isinstance(sub.value, ast.Call)
+                    and _callee_basename(sub.value.func) == _DMA_FACTORY
+                ):
+                    out.add(node.name)
+                    break
+        return out
+
+    def _check_dma(self, ctx, fn) -> list[Diagnostic]:
+        factories = self._dma_factories(fn)
+        # Simple local bindings: c = make_async_remote_copy(...) (or a
+        # factory call) — `c.start()` then pairs under the name c.
+        bound: dict[str, str] = {}
+        for node in _own_nodes(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                callee = _callee_basename(node.value.func)
+                if callee == _DMA_FACTORY or callee in factories:
+                    bound[node.targets[0].id] = callee or _DMA_FACTORY
+
+        def copy_key(recv: ast.expr) -> str | None:
+            if isinstance(recv, ast.Call):
+                callee = _callee_basename(recv.func)
+                if callee == _DMA_FACTORY or callee in factories:
+                    return callee
+            if isinstance(recv, ast.Name) and recv.id in bound:
+                return recv.id
+            return None
+
+        started: dict[str, tuple[int, int]] = {}
+        waits: dict[str, set[str]] = {}
+        for node in _own_nodes(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            key = copy_key(node.func.value)
+            if key is None:
+                continue
+            if node.func.attr == "start":
+                started.setdefault(key, (node.lineno, node.col_offset))
+            elif node.func.attr in _WAIT_ATTRS:
+                waits.setdefault(key, set()).add(node.func.attr)
+        diags = []
+        for key, (line, col) in sorted(started.items(), key=lambda kv: kv[1]):
+            got = waits.get(key, set())
+            label = (
+                "the remote copy" if key == _DMA_FACTORY
+                else f"copies from {key!r}"
+            )
+            if not got:
+                diags.append(
+                    Diagnostic(
+                        ctx.relpath, line, col, "DS901",
+                        f"{label} started but never waited in "
+                        f"{fn.name!r}: the DMA may still be in flight when "
+                        "the kernel completes (add wait(), or wait_recv() + "
+                        "wait_send())",
+                    )
+                )
+            elif "wait" not in got and got != {"wait_recv", "wait_send"}:
+                missing = sorted({"wait_recv", "wait_send"} - got)
+                diags.append(
+                    Diagnostic(
+                        ctx.relpath, line, col, "DS902",
+                        f"{label} drains {sorted(got)} but never "
+                        f"{missing} in {fn.name!r}: the un-drained "
+                        "direction races buffer reuse",
+                    )
+                )
+        return diags
+
+    # -- DS903 ---------------------------------------------------------------
+
+    def _check_threads(self, ctx, fns) -> list[Diagnostic]:
+        # Module-wide join census: `.join()` receivers by name/attr.
+        joined_names: set[str] = set()
+        joined_attrs: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                continue
+            recv = node.func.value
+            if isinstance(recv, ast.Name):
+                joined_names.add(recv.id)
+            elif isinstance(recv, ast.Attribute):
+                joined_attrs.add(recv.attr)
+        # Assignment targets per Thread call.
+        targets: dict[int, ast.expr] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                targets[id(node.value)] = node.targets[0]
+        diags = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _callee_basename(node.func) == "Thread"
+            ):
+                continue
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    daemon = kw.value
+            if (
+                daemon is not None
+                and isinstance(daemon, ast.Constant)
+                and daemon.value is True
+            ):
+                continue
+            target = targets.get(id(node))
+            ok = False
+            if isinstance(target, ast.Name):
+                ok = target.id in joined_names
+            elif isinstance(target, ast.Attribute):
+                ok = target.attr in joined_attrs
+            elif target is None:
+                # List-comprehension / loop-built thread sets: any .join()
+                # in the module keeps the loose pairing honest.
+                ok = bool(joined_names or joined_attrs)
+            if not ok:
+                diags.append(
+                    Diagnostic(
+                        ctx.relpath, node.lineno, node.col_offset, "DS903",
+                        "thread is neither daemon=True nor joined anywhere "
+                        "in this module: it outlives its owner and blocks "
+                        "interpreter exit",
+                    )
+                )
+        return diags

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import ast
 
+from dsort_tpu.analysis.astutil import callee_basename as _callee_basename
 from dsort_tpu.analysis.core import Diagnostic
 from dsort_tpu.analysis.engine import Checker, FileContext
 
@@ -47,15 +48,6 @@ _LOG_RECEIVERS = {"log", "logger", "logging"}
 _CLOCK_ATTRS = {"time", "monotonic", "perf_counter", "process_time", "sleep"}
 _BUILTIN_EFFECTS = {"print", "open", "input"}
 _STATIC_OK_ATTRS = {"shape", "dtype", "ndim", "size"}
-
-
-def _callee_basename(func: ast.expr) -> str | None:
-    """Rightmost name of a call target: ``jax.jit`` -> ``jit``."""
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return None
 
 
 def _is_partial(call: ast.Call) -> bool:

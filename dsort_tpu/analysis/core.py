@@ -124,9 +124,13 @@ class LintConfig:
     """Engine configuration (the ``[tool.dsort.lint]`` pyproject table).
 
     ``root`` anchors every relative path: scope globs match against
-    root-relative file paths, and ``registry_path``/``native_map_path``
-    default to the project's own registry sources so the registry checker
-    reads THE vocabulary, not a copy.
+    root-relative file paths, and ``registry_path``/``native_map_path``/
+    ``proto_registry_path``/``admission_registry_path`` default to the
+    project's own registry sources so the registry checkers read THE
+    vocabulary, not a copy.  ``layers`` is the ``[tool.dsort.lint.layers]``
+    sub-table: module pattern (``pkg.mod`` or ``pkg.sub.*``) -> tuple of
+    import roots that module must never reach, transitively, at import
+    time (the DS6xx purity contract).
     """
 
     root: str = "."
@@ -134,6 +138,11 @@ class LintConfig:
     baseline: str | None = None
     registry_path: str = os.path.join("dsort_tpu", "utils", "events.py")
     native_map_path: str = os.path.join("dsort_tpu", "runtime", "native.py")
+    proto_registry_path: str = os.path.join("dsort_tpu", "fleet", "proto.py")
+    admission_registry_path: str = os.path.join(
+        "dsort_tpu", "serve", "admission.py"
+    )
+    layers: dict = dataclasses.field(default_factory=dict)
 
     def abspath(self, rel: str | None) -> str | None:
         if rel is None:
@@ -142,11 +151,13 @@ class LintConfig:
 
 
 def _read_lint_table(path: str) -> dict:
-    """The ``[tool.dsort.lint]`` table of a pyproject.toml.
+    """The ``[tool.dsort.lint]`` table of a pyproject.toml (including the
+    ``[tool.dsort.lint.layers]`` sub-table, surfaced as ``table["layers"]``).
 
     Uses ``tomllib`` when available (3.11+); on 3.10 falls back to a
-    section-scoped reader that handles exactly the value shapes this table
-    uses (strings and string arrays) — no dependency may be added for this.
+    section-scoped reader that handles exactly the value shapes these
+    tables use (strings, string arrays, and quoted-dotted-name keys) — no
+    dependency may be added for this.
     """
     try:
         import tomllib
@@ -158,21 +169,34 @@ def _read_lint_table(path: str) -> dict:
                 tomllib.load(f).get("tool", {}).get("dsort", {}).get("lint", {})
             )
     table: dict = {}
-    in_section = False
+    section = None  # "lint" | "layers" | None
     with open(path, encoding="utf-8") as f:
-        for raw in f:
+        lines = iter(f)
+        for raw in lines:
             line = raw.strip()
             if line.startswith("["):
-                in_section = line == "[tool.dsort.lint]"
+                section = {
+                    "[tool.dsort.lint]": "lint",
+                    "[tool.dsort.lint.layers]": "layers",
+                }.get(line)
                 continue
-            if not in_section or "=" not in line or line.startswith("#"):
+            if section is None or "=" not in line or line.startswith("#"):
                 continue
             key, _, val = line.partition("=")
-            key, val = key.strip(), val.strip()
+            key, val = key.strip().strip('"'), val.strip()
+            # Multi-line arrays: accumulate until the closing bracket.
+            while val.startswith("[") and "]" not in val:
+                val += " " + next(lines, "]").strip()
             if val.startswith("["):
-                table[key] = re.findall(r'"([^"]*)"', val)
+                parsed = re.findall(r'"([^"]*)"', val)
             elif val.startswith('"'):
-                table[key] = val.strip('"')
+                parsed = val.strip('"')
+            else:
+                continue
+            if section == "layers":
+                table.setdefault("layers", {})[key] = parsed
+            else:
+                table[key] = parsed
     return table
 
 
@@ -192,4 +216,13 @@ def load_config(root: str) -> LintConfig:
         cfg.registry_path = table["registry"]
     if "native_map" in table:
         cfg.native_map_path = table["native_map"]
+    if "proto_registry" in table:
+        cfg.proto_registry_path = table["proto_registry"]
+    if "admission_registry" in table:
+        cfg.admission_registry_path = table["admission_registry"]
+    if "layers" in table:
+        cfg.layers = {
+            str(mod): tuple(forbidden)
+            for mod, forbidden in dict(table["layers"]).items()
+        }
     return cfg

@@ -23,6 +23,21 @@ import uuid
 import numpy as np
 
 
+def fsync_publish(tmp: str, path: str) -> None:
+    """The durability half of write-then-rename: fsync ``tmp``'s bytes,
+    THEN ``os.replace`` it into place.  Every resume path in this module
+    trusts a listed-complete file to hold its data — without the fsync the
+    rename can land while the payload is still only in the page cache, so
+    an OS/host loss could leave a whole-looking but empty checkpoint
+    (`dsort lint` DS702 pins the idiom on every writer)."""
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+
+
 class ShardCheckpoint:
     """Per-job shard result store keyed by (checkpoint_dir, job_id)."""
 
@@ -74,6 +89,8 @@ class ShardCheckpoint:
         return os.path.join(self.dir, f"shard_{shard_id:05d}.npy")
 
     def write_manifest(self, num_shards: int, dtype, total: int, **extra) -> None:
+        # The manifest is THE staleness guard: it must be durable before
+        # any shard it blesses can be trusted (tmp+fsync+rename).
         tmp = f"{self._manifest_path}.{self._token}.tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(
@@ -81,7 +98,7 @@ class ShardCheckpoint:
                  "total": total, **extra},
                 f,
             )
-        os.replace(tmp, self._manifest_path)
+        fsync_publish(tmp, self._manifest_path)
 
     def sync_manifest(
         self, num_shards: int, dtype, total: int, fingerprint: str
@@ -135,7 +152,7 @@ class ShardCheckpoint:
         path = self._shard_path(shard_id)
         tmp = f"{path}.{self._token}.tmp.npy"
         np.save(tmp, np.asarray(arr))
-        os.replace(tmp, path)
+        fsync_publish(tmp, path)
         if self.journal is not None:
             self.journal.emit(
                 "checkpoint_persist", kind="shard", id=shard_id, n=len(arr)
@@ -178,7 +195,7 @@ class ShardCheckpoint:
         path = self._range_path(range_id)
         tmp = f"{path}.{self._token}.tmp.npy"
         np.save(tmp, np.asarray(arr))
-        os.replace(tmp, path)
+        fsync_publish(tmp, path)
         if self.journal is not None:
             self.journal.emit(
                 "checkpoint_persist", kind="range", id=range_id, n=len(arr)
@@ -223,7 +240,7 @@ class ShardCheckpoint:
         path = self._aux_path(tag, idx)
         tmp = f"{path}.{self._token}.tmp.npy"
         np.save(tmp, np.asarray(arr))
-        os.replace(tmp, path)
+        fsync_publish(tmp, path)
         if self.journal is not None:
             self.journal.emit(
                 "checkpoint_persist", kind=f"aux_{tag}", id=idx, n=len(arr)
@@ -267,17 +284,11 @@ class ShardCheckpoint:
         path = self._aux_path(self._wave_tag(wave), run)
         tmp = f"{path}.{self._token}.tmp.npy"
         np.save(tmp, np.asarray(arr))
-        # The (wave, run) resume contract is a DURABILITY contract: a
-        # resume trusts completed_wave_runs(), so a run listed complete
-        # must survive an OS/host loss, not just a process kill — fsync
-        # before the rename makes the bytes durable (the wave pipeline
-        # hides this wait behind the next wave's device exchange).
-        fd = os.open(tmp, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-        os.replace(tmp, path)
+        # The (wave, run) resume contract is a DURABILITY contract: a run
+        # listed complete must survive an OS/host loss, not just a process
+        # kill (the wave pipeline hides the fsync wait behind the next
+        # wave's device exchange).
+        fsync_publish(tmp, path)
         if self.journal is not None:
             self.journal.emit(
                 "checkpoint_persist", kind="wave_run", wave=wave, id=run,

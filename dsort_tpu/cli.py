@@ -756,6 +756,10 @@ def cmd_fleet(args) -> int:
         fleet_cfg = dataclasses.replace(fleet_cfg, state_dir=args.state_dir)
     if getattr(args, "routing", None):
         fleet_cfg = dataclasses.replace(fleet_cfg, routing=args.routing)
+    if getattr(args, "dispatch_timeout", None):
+        fleet_cfg = dataclasses.replace(
+            fleet_cfg, dispatch_timeout_s=args.dispatch_timeout
+        )
     agents = getattr(args, "agents", None) or ",".join(fleet_cfg.agents)
     if not agents:
         raise SystemExit(
@@ -781,6 +785,7 @@ def cmd_fleet(args) -> int:
         slo_shed_ms=args.slo_shed_ms or cfg.serve.slo_shed_ms,
         routing=fleet_cfg.routing,
         heartbeat_s=fleet_cfg.heartbeat_s,
+        dispatch_timeout_s=fleet_cfg.dispatch_timeout_s,
         default_tenant=cfg.job.tenant,
         journal=journal,
         journal_path=getattr(args, "journal", None),
@@ -2243,14 +2248,46 @@ def _project_root(start: str) -> str:
         d = parent
 
 
+def _git_changed_files(root: str) -> list[str]:
+    """Lintable files changed vs HEAD (worktree + index) plus untracked
+    ones, absolute paths.  Loud on any git failure — a broken `--changed`
+    must never pass vacuously as "0 files changed"."""
+    import subprocess
+
+    def run(*argv):
+        r = subprocess.run(
+            ["git", "-C", root, *argv], capture_output=True, text=True,
+        )
+        if r.returncode != 0:
+            raise SystemExit(
+                f"dsort lint --changed: git {' '.join(argv)} failed: "
+                f"{r.stderr.strip() or r.returncode}"
+            )
+        return r.stdout.splitlines()
+
+    # --relative anchors diff paths at `root` (not the git toplevel).
+    names = set(run("diff", "--name-only", "--relative", "HEAD"))
+    names.update(run("ls-files", "--others", "--exclude-standard"))
+    from dsort_tpu.analysis.engine import _LINTABLE
+
+    out = []
+    for name in sorted(names):
+        path = os.path.join(root, name)
+        if name.endswith(_LINTABLE) and os.path.exists(path):
+            out.append(path)
+    return out
+
+
 def cmd_lint(args) -> int:
     """Run the project-native static analysis suite (`dsort_tpu.analysis`).
 
     Checks the invariants the fault-tolerance story rests on — registry
     coverage (Python AND the C++ coordinator's event vocabulary),
     lock discipline, tracing hygiene, recovery-path exception hygiene,
-    compat-shim routing — without running a cluster or touching a backend.
-    Exit 0 = clean (modulo baseline), 1 = findings.
+    compat-shim routing, import-layer purity, durability discipline,
+    protocol coverage, kernel/thread lifecycle — without running a
+    cluster or touching a backend.  Exit 0 = clean (modulo baseline),
+    1 = findings.
     """
     from dsort_tpu.analysis import (
         format_json,
@@ -2264,15 +2301,47 @@ def cmd_lint(args) -> int:
     cfg = load_config(root)
     if args.baseline:
         cfg.baseline = args.baseline
-    # User-given paths resolve against CWD (normal CLI semantics); only the
-    # default target is root-relative.  A missing path is a loud error —
-    # a typo'd CI invocation must never pass vacuously as "0 findings".
-    paths = [os.path.abspath(p) for p in args.paths] or [
-        os.path.join(root, "dsort_tpu")
-    ]
-    missing = [p for p in paths if not os.path.exists(p)]
-    if missing:
-        raise SystemExit(f"dsort lint: no such path(s): {missing}")
+    # Content-hash result cache: `make lint` stays interactive on the
+    # grown tree (invalidated by any checker/config/registry change).
+    cache_path = (
+        None if args.no_cache else os.path.join(root, ".lint-cache.json")
+    )
+    if args.changed:
+        if args.paths:
+            raise SystemExit(
+                "dsort lint: --changed and explicit paths are exclusive"
+            )
+        if args.write_baseline:
+            # The baseline is a whole-tree artifact: regenerating it from
+            # a changed-files subset would silently drop every tolerated
+            # entry for unchanged files.
+            raise SystemExit(
+                "dsort lint: --changed and --write-baseline are exclusive "
+                "(the baseline must be regenerated from the full tree)"
+            )
+        # Scope to the DEFAULT lint target (the package tree) when it
+        # exists: a touched test fixture is bad by design and must not
+        # fail the pre-commit pass.  A root without the package (another
+        # project borrowing the linter) keeps the root-wide scope.
+        paths = _git_changed_files(root)
+        target = os.path.join(root, "dsort_tpu")
+        if os.path.isdir(target):
+            target += os.sep
+            paths = [p for p in paths if p.startswith(target)]
+        if not paths:
+            sys.stdout.write("dsort lint: no changed lintable files\n")
+            return 0
+    else:
+        # User-given paths resolve against CWD (normal CLI semantics); only
+        # the default target is root-relative.  A missing path is a loud
+        # error — a typo'd CI invocation must never pass vacuously as
+        # "0 findings".
+        paths = [os.path.abspath(p) for p in args.paths] or [
+            os.path.join(root, "dsort_tpu")
+        ]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            raise SystemExit(f"dsort lint: no such path(s): {missing}")
     if args.write_baseline:
         # Capture EVERYTHING the tree currently shows: linting through the
         # existing baseline would drop already-tolerated findings and the
@@ -2281,11 +2350,11 @@ def cmd_lint(args) -> int:
             root, ".lint-baseline.json"
         )
         cfg.baseline = None
-        diags = lint_paths(paths, cfg)
+        diags = lint_paths(paths, cfg, cache_path=cache_path)
         write_baseline(path, diags)
         log.info("baseline written to %s (%d entries)", path, len(diags))
         return 0
-    diags = lint_paths(paths, cfg)
+    diags = lint_paths(paths, cfg, cache_path=cache_path)
     sys.stdout.write(
         format_json(diags) if args.format == "json" else format_text(diags)
     )
@@ -2480,6 +2549,11 @@ def main(argv=None) -> int:
     p.add_argument("--routing", choices=["locality", "random"],
                    help="variant-cache-locality routing (default) or the "
                         "random A/B baseline (conf FLEET_ROUTING)")
+    p.add_argument("--dispatch-timeout", type=float,
+                   help="per-agent send deadline in seconds: a stuck-but-"
+                        "connected agent fails over after this long "
+                        "(conf FLEET_DISPATCH_TIMEOUT_S; default: the "
+                        "request timeout)")
     p.add_argument("--metrics-port", type=int,
                    help="expose the controller's telemetry endpoint")
     p.add_argument("--max-in-flight", type=int, default=1,
@@ -2691,6 +2765,12 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="record the current findings as tolerated (the "
                         "shipped tree keeps this file empty)")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files changed vs git HEAD (plus "
+                        "untracked) — the interactive pre-commit scope")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the content-hash result cache "
+                        "(.lint-cache.json)")
     p.add_argument("--root",
                    help="project root (default: nearest pyproject.toml)")
     p.set_defaults(fn=cmd_lint)

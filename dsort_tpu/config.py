@@ -322,13 +322,17 @@ class FleetConfig:
     (``FLEET_STATE_DIR`` — without it a restart loses queued jobs);
     ``routing`` picks variant-cache-locality routing or the random A/B
     baseline (``FLEET_ROUTING``); ``heartbeat_s`` paces the controller's
-    agent pings (``FLEET_HEARTBEAT_S``).
+    agent pings (``FLEET_HEARTBEAT_S``); ``dispatch_timeout_s`` is the
+    per-agent SEND deadline — how long one agent may sit on a submit
+    before its lane fails it over (``FLEET_DISPATCH_TIMEOUT_S``; None =
+    the controller's request timeout).
     """
 
     agents: tuple[str, ...] = ()
     state_dir: str | None = None
     routing: str = "locality"
     heartbeat_s: float = 2.0
+    dispatch_timeout_s: float | None = None
 
     def __post_init__(self) -> None:
         from dsort_tpu.fleet.proto import ROUTING_POLICIES
@@ -341,6 +345,11 @@ class FleetConfig:
         if self.heartbeat_s <= 0:
             raise ConfigError(
                 f"heartbeat_s must be > 0, got {self.heartbeat_s}"
+            )
+        if self.dispatch_timeout_s is not None and self.dispatch_timeout_s <= 0:
+            raise ConfigError(
+                f"dispatch_timeout_s must be > 0, got "
+                f"{self.dispatch_timeout_s}"
             )
         for a in self.agents:
             if ":" not in str(a):
@@ -438,6 +447,10 @@ class SortConfig:
             routing=m.get("FLEET_ROUTING", FleetConfig.routing),
             heartbeat_s=float(
                 m.get("FLEET_HEARTBEAT_S", FleetConfig.heartbeat_s)
+            ),
+            dispatch_timeout_s=(
+                float(m["FLEET_DISPATCH_TIMEOUT_S"])
+                if m.get("FLEET_DISPATCH_TIMEOUT_S") else None
             ),
         )
         return cls(

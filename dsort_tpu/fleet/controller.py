@@ -68,6 +68,11 @@ class ControllerClosed(RuntimeError):
     """The controller is shut down; the job was not (or will not be) run."""
 
 
+class LaneBusy(RuntimeError):
+    """A per-link request slot was busy within the caller's lock bound —
+    the caller should skip this round, not fail the agent."""
+
+
 class FleetTicket:
     """Future-style handle for one admitted fleet job (`JobTicket` twin)."""
 
@@ -102,8 +107,10 @@ class _Job:
         self.dtype = dtype
         self.label = label
         self.ticket = ticket
-        self.status = "queued"      # queued | inflight | done | failed
-        self.agent: str | None = None  # agent_id while inflight
+        # queued | dispatching (handed to an agent lane) | inflight
+        # (agent accepted) | done | failed
+        self.status = "queued"
+        self.agent: str | None = None  # agent_id while dispatching/inflight
         self.readmits = 0
         self.data: np.ndarray | None = None  # in-memory payload (pre-spool)
         self.queued_mono = time.monotonic()
@@ -112,7 +119,15 @@ class _Job:
         return {
             "tenant": self.tenant, "n_keys": self.n_keys,
             "dtype": self.dtype, "label": self.label,
-            "status": self.status, "agent": self.agent,
+            # "dispatching" persists as "inflight": across a restart the
+            # agent may or may not have received the submit, and the
+            # reconcile pass already resolves exactly that ambiguity (the
+            # agent reports running/done/failed/unknown; unknown re-queues
+            # — at-least-once, never lost).
+            "status": (
+                "inflight" if self.status == "dispatching" else self.status
+            ),
+            "agent": self.agent,
             "readmits": self.readmits,
         }
 
@@ -130,6 +145,8 @@ class _AgentLink:
         self.capacity = 1
         self.variants: set[str] = set()
         self.inflight: set[str] = set()  # fleet jids dispatched here
+        self.pending: list[str] = []     # jids routed here, lane not yet sent
+        self.dispatching = 0             # jobs the lane is actively sending
         self.job_statuses: dict[str, str] = {}  # last welcome's re-attach map
         self.send_lock = threading.Lock()
         self.req_lock = threading.Lock()   # one outstanding request
@@ -157,6 +174,7 @@ class FleetController:
         routing_seed: int = 0,
         heartbeat_s: float = 2.0,
         request_timeout_s: float = 30.0,
+        dispatch_timeout_s: float | None = None,
         default_tenant: str = "default",
         journal=None,
         journal_path: str | None = None,
@@ -174,6 +192,15 @@ class FleetController:
         self._rng = random.Random(routing_seed)
         self.heartbeat_s = float(heartbeat_s)
         self.request_timeout_s = float(request_timeout_s)
+        # The per-agent SEND deadline: how long one agent may sit on a
+        # submit before it is failed over.  Bounded separately from the
+        # request timeout so a stuck-but-connected agent costs its own
+        # lane at most this long (it never stalls the fleet — dispatch
+        # runs on per-agent lanes).
+        self.dispatch_timeout_s = (
+            float(dispatch_timeout_s) if dispatch_timeout_s is not None
+            else self.request_timeout_s
+        )
         self.default_tenant = default_tenant
         self.journal = journal
         self.journal_path = journal_path
@@ -223,6 +250,17 @@ class FleetController:
             target=self._heartbeat_loop, daemon=True,
             name="dsort-fleet-heartbeat",
         )
+        # One dispatch lane per agent: the dispatcher only routes; the
+        # lane does the socket round-trip.  A stuck-but-connected agent
+        # blocks ITS lane, never fleet-wide dispatch (ROADMAP item 1's
+        # named stall).
+        self._lanes = [
+            threading.Thread(
+                target=self._lane_loop, args=(link,), daemon=True,
+                name=f"dsort-fleet-lane-{link.addr[1]}",
+            )
+            for link in self._links.values()
+        ]
         self._started = False
         self._publish_gauges()
         if start:
@@ -235,6 +273,8 @@ class FleetController:
             self._started = True
             self._dispatcher.start()
             self._heartbeater.start()
+            for lane in self._lanes:
+                lane.start()
 
     def __enter__(self) -> "FleetController":
         return self
@@ -275,7 +315,7 @@ class FleetController:
             "agents": agents,
             "jobs": {
                 jid: j.state() for jid, j in self._jobs.items()
-                if j.status in ("queued", "inflight")
+                if j.status in ("queued", "dispatching", "inflight")
             },
         }
         self._persist_seq += 1
@@ -451,14 +491,23 @@ class FleetController:
 
     def _request(self, link: _AgentLink, header: dict, payload: bytes = b"",
                  timeout: float | None = None,
-                 expect: tuple = ()) -> tuple[dict, bytes]:
+                 expect: tuple = (),
+                 lock_timeout: float | None = None) -> tuple[dict, bytes]:
         """One request/reply round-trip (requests serialize per link; the
         reader thread routes non-result frames back here).  ``expect``
         names the acceptable reply types: a stale reply from a previous
         timed-out round (a late heartbeat racing a submit) is discarded,
-        never mis-associated."""
+        never mis-associated.  ``lock_timeout`` bounds how long the caller
+        will wait for the per-link request slot — raising `LaneBusy`
+        instead of queueing behind a long in-flight dispatch."""
         timeout = timeout or self.request_timeout_s
-        with link.req_lock:
+        if not link.req_lock.acquire(
+            timeout=-1 if lock_timeout is None else lock_timeout
+        ):
+            raise LaneBusy(
+                f"agent {link.label()} request slot busy (mid-dispatch)"
+            )
+        try:
             with link._reply_cv:
                 link._replies.clear()  # drop stale replies from a dead round
             with link.send_lock:
@@ -484,6 +533,8 @@ class FleetController:
                             f"{header.get('type')} within {timeout}s"
                         )
                     link._reply_cv.wait(timeout=min(left, 0.5))
+        finally:
+            link.req_lock.release()
 
     def _send(self, link: _AgentLink, header: dict, payload: bytes = b"") -> None:
         with link.send_lock:
@@ -511,11 +562,12 @@ class FleetController:
                 # out its full timeout while the whole fleet's dispatch
                 # stalls behind it.
                 link._reply_cv.notify_all()
-            lost = sorted(link.inflight)
+            lost = sorted(link.inflight) + list(link.pending)
             link.inflight.clear()
+            link.pending.clear()
             for jid in lost:
                 job = self._jobs.get(jid)
-                if job is not None and job.status == "inflight":
+                if job is not None and job.status in ("inflight", "dispatching"):
                     self._requeue_locked(job, frm=link.aid, reason="agent_lost")
             self._persist_locked()
             self._cv.notify_all()
@@ -527,6 +579,7 @@ class FleetController:
         self._publish_gauges()
 
     def _requeue_locked(self, job: _Job, frm: str | None, reason: str) -> None:
+        self._discard_inflight_locked(job.jid)
         job.status = "queued"
         job.agent = None
         job.readmits += 1
@@ -564,9 +617,17 @@ class FleetController:
                                     link.label(), e)
                     continue
                 try:
+                    # Bounded wait for the request slot: a lane mid-send to
+                    # a stuck agent holds it for up to dispatch_timeout_s,
+                    # and the health plane must not serialize behind one
+                    # stall (the in-flight dispatch IS a liveness probe —
+                    # its own deadline will fail the agent if it is dead).
                     header, _ = self._request(
-                        link, {"type": "ping"}, expect=("heartbeat",)
+                        link, {"type": "ping"}, expect=("heartbeat",),
+                        lock_timeout=min(self.heartbeat_s, 1.0),
                     )
+                except LaneBusy:
+                    continue
                 except (OSError, TimeoutError, ProtocolError) as e:
                     self._agent_down(link, f"heartbeat: {e}")
                     continue
@@ -604,10 +665,14 @@ class FleetController:
         """Agents with a free outstanding slot right now.  Outstanding
         dispatches are bounded by the agent's advertised capacity (its
         slice count) — backpressure is the controller's own queue, never a
-        reject-retry loop against a busy agent."""
+        reject-retry loop against a busy agent.  Lane-pending and
+        actively-sending jobs count against the slot: the dispatcher must
+        not pile a slow agent's lane high with work other agents could
+        take."""
         return [
             l for l in self._eligible_locked()
-            if len(l.inflight) < max(l.capacity, 1)
+            if (len(l.inflight) + len(l.pending) + l.dispatching)
+            < max(l.capacity, 1)
         ]
 
     def submit(
@@ -713,7 +778,12 @@ class FleetController:
         assert live, "dispatch loop gates on a dispatchable agent"
 
         def loaded(l):
-            return (len(l.inflight) / max(l.capacity, 1), l.label())
+            # Lane-pending and actively-sending jobs ARE load: during a
+            # burst the dispatcher routes many jobs before the first
+            # accept returns, and counting only accepted inflight would
+            # scatter rungs across idle-LOOKING agents.
+            busy = len(l.inflight) + len(l.pending) + l.dispatching
+            return (busy / max(l.capacity, 1), l.label())
 
         if job.n_keys >= FLEET_SMALL_JOB_MAX:
             cands = [l for l in live if l.big_jobs] or live
@@ -721,13 +791,35 @@ class FleetController:
         if self.routing == "random":
             return self._rng.choice(live), "random"
         prefix = fused_rung_prefix(job.n_keys, job.dtype)
+
+        def sticky_ok(l):
+            if l in live:
+                return True
+            # A busy home agent is worth a SHORT wait only when the rung
+            # is ALREADY COMPILED there (it advertises the variant):
+            # under a burst the dispatcher routes the whole queue before
+            # any result returns, and without this bounded lane backlog
+            # (one extra capacity's worth) every same-rung job would
+            # spill and recompile the rung on another mesh.  A
+            # never-compiled rung is not worth waiting for — spilling
+            # compiles it somewhere idle instead.
+            return (
+                l.alive and not l.draining
+                and any(v.startswith(prefix) for v in l.variants)
+                and (len(l.inflight) + len(l.pending) + l.dispatching)
+                < 2 * max(l.capacity, 1)
+            )
+
         # Sticky affinity first: the rung's home agent (set at its first
         # dispatch) keeps it deterministic even before a heartbeat refresh
         # advertises the freshly compiled variant.
         aff = self._link_by_aid_locked(self._affinity.get(prefix))
-        if aff is not None and aff in live:
+        if aff is not None and sticky_ok(aff):
             return aff, "locality"
-        hit = [l for l in live if any(v.startswith(prefix) for v in l.variants)]
+        hit = [
+            l for l in self._eligible_locked()
+            if sticky_ok(l) and any(v.startswith(prefix) for v in l.variants)
+        ]
         if hit:
             link = min(hit, key=loaded)
             self._affinity[prefix] = link.aid
@@ -756,6 +848,14 @@ class FleetController:
             ) from e
 
     def _dispatch_loop(self) -> None:
+        """Pop jobs in DRR order and ROUTE them — onto per-agent lanes.
+
+        The dispatcher never touches a socket: the submit round-trip runs
+        on the routed agent's own lane thread, so one stuck-but-connected
+        agent blocks its lane for at most ``dispatch_timeout_s`` while
+        every other agent keeps receiving work (the ROADMAP-named
+        fleet-wide dispatch stall is gone; drilled in
+        ``tests/test_fleet.py``)."""
         while not self._dead:
             with self._cv:
                 nxt = None
@@ -770,7 +870,8 @@ class FleetController:
                         self._shutdown
                         and self._policy.queue_depth == 0
                         and not any(
-                            j.status == "inflight" for j in self._jobs.values()
+                            j.status in ("inflight", "dispatching")
+                            for j in self._jobs.values()
                         )
                     ):
                         return
@@ -782,67 +883,19 @@ class FleetController:
                 link, reason = self._route_locked(job)
                 wait_s = time.monotonic() - job.queued_mono
                 self._policy.note_wait(tenant, wait_s)
-            if self._dead:
-                return
-            try:
-                payload_arr = self._job_payload(job)
-                meta, payload = encode_array(payload_arr)
-                header, _ = self._request(
-                    link,
-                    {"type": "submit", "job_id": jid, "tenant": tenant,
-                     "label": job.label, **meta},
-                    payload,
-                    expect=("accepted", "rejected"),
-                )
-            except (OSError, TimeoutError, ProtocolError) as e:
-                self._agent_down(link, f"dispatch: {e}")
-                with self._cv:
-                    if job.status == "queued":
-                        # pop() already dequeued it; put it back through
-                        # the full re-route path (journaled job_rerouted,
-                        # readmits bump, fresh queue-wait clock).
-                        self._requeue_locked(job, frm=link.aid,
-                                             reason="dispatch_failed")
-                        self._persist_locked()
-                        self._cv.notify_all()
-                self._flush_persist()
-                continue
-            except Exception as e:
-                # ANY payload/encode failure (a torn spool after a crash
-                # mid-write raises ValueError from np.load) must fail THAT
-                # job, never kill the daemon dispatcher and freeze the
-                # fleet.
-                self._finish_error(job, e)
-                continue
-            if header.get("type") == "rejected":
-                # The agent's local admission refused (draining/bounded):
-                # re-queue and let routing try elsewhere next round.  The
-                # every-agent-rejects bound is decided BEFORE re-queueing —
-                # failing a job AFTER its token went back in the DRR would
-                # leave a phantom entry inflating the queue depth.
-                exhausted = job.readmits >= 3 * max(len(self._links), 1)
-                with self._cv:
-                    link.draining = link.draining or (
-                        header.get("reason") == "shutting_down"
-                    )
-                    if not exhausted:
-                        self._requeue_locked(job, frm=link.aid,
-                                             reason=str(header.get("reason")))
-                        self._persist_locked()
-                    self._cv.notify_all()
-                self._flush_persist()
-                if exhausted:
-                    self._finish_error(job, ControllerClosed(
-                        f"job {jid} rejected by every agent "
-                        f"({header.get('reason')})"
-                    ))
-                time.sleep(0.05)
-                continue
-            # The dispatch HAPPENED (the agent accepted): journal it now,
-            # unconditionally — a fast agent can deliver the result before
-            # the state block below runs, and the routing decision must
-            # still appear in the trace (the restart drill asserts routed
-            # order against the DRR replay).
+                job.status = "dispatching"
+                job.agent = link.aid
+                link.pending.append(jid)
+                self._persist_locked()
+                self._cv.notify_all()
+            # Journal the routing DECISION here, in the dispatcher: pops
+            # happen in DRR order on this one thread, so the job_routed
+            # sequence in the trace IS the fairness order (the restart
+            # drill replays the persisted policy against it) — per-agent
+            # lanes would race accept-time emission across agents.  A
+            # fast result can't swallow it either: it is written before
+            # the submit leaves the process.  A failed dispatch follows
+            # with job_rerouted, keeping the trace honest.
             job.ticket.metrics.event(
                 "job_dequeued", tenant=tenant, wait_s=round(wait_s, 6),
                 big=job.n_keys >= FLEET_SMALL_JOB_MAX, agent=link.label(),
@@ -852,28 +905,110 @@ class FleetController:
                 "job_routed", job_id=jid, tenant=tenant, agent=link.label(),
                 reason=reason, n_keys=job.n_keys,
             )
+            self._flush_persist()
+
+    def _lane_loop(self, link: _AgentLink) -> None:
+        """One agent's dispatch lane: pull jobs the dispatcher routed
+        here, run the submit round-trip, transition the state."""
+        while True:
             with self._cv:
-                if job.status != "queued":
-                    # The result beat us here: the job is already finished
-                    # — never resurrect it as inflight or re-occupy the
-                    # slot its completion just freed.
-                    continue
-                if not link.alive:
-                    # The agent died between the accepted reply and here
-                    # (its _agent_down saw the job still 'queued' and
-                    # re-queued nothing): treat as agent loss ourselves —
-                    # at-least-once, never a stranded inflight on a dead
-                    # link that no later path would revisit.
+                while not link.pending and not self._dead and not self._closed:
+                    self._cv.wait(timeout=0.1)
+                if self._dead or self._closed:
+                    return
+                jid = link.pending.pop(0)
+                job = self._jobs.get(jid)
+                if job is None or job.status != "dispatching":
+                    continue  # requeued/finished while lane-pending
+                link.dispatching += 1
+            try:
+                self._dispatch_one(link, job)
+            finally:
+                with self._cv:
+                    link.dispatching -= 1
+                    self._cv.notify_all()
+
+    def _dispatch_one(self, link: _AgentLink, job: _Job) -> None:
+        jid, tenant = job.jid, job.tenant
+        try:
+            payload_arr = self._job_payload(job)
+            meta, payload = encode_array(payload_arr)
+            header, _ = self._request(
+                link,
+                {"type": "submit", "job_id": jid, "tenant": tenant,
+                 "label": job.label, **meta},
+                payload,
+                timeout=self.dispatch_timeout_s,
+                expect=("accepted", "rejected"),
+            )
+        except (OSError, TimeoutError, ProtocolError) as e:
+            self._agent_down(link, f"dispatch: {e}")
+            with self._cv:
+                if job.status == "dispatching":
+                    # _agent_down only re-queues inflight/pending jobs; the
+                    # one mid-send is this lane's to put back through the
+                    # full re-route path (journaled job_rerouted, readmits
+                    # bump, fresh queue-wait clock).
                     self._requeue_locked(job, frm=link.aid,
-                                         reason="agent_lost")
-                else:
-                    job.status = "inflight"
-                    job.agent = link.aid
-                    link.inflight.add(jid)
-                self._persist_locked()
+                                         reason="dispatch_failed")
+                    self._persist_locked()
+                    self._cv.notify_all()
+            self._flush_persist()
+            return
+        except Exception as e:
+            # ANY payload/encode failure (a torn spool after a crash
+            # mid-write raises ValueError from np.load) must fail THAT
+            # job, never kill the daemon lane and freeze its agent.
+            self._finish_error(job, e)
+            return
+        if header.get("type") == "rejected":
+            # The agent's local admission refused (draining/bounded):
+            # re-queue and let routing try elsewhere next round.  The
+            # every-agent-rejects bound is decided BEFORE re-queueing —
+            # failing a job AFTER its token went back in the DRR would
+            # leave a phantom entry inflating the queue depth.
+            exhausted = job.readmits >= 3 * max(len(self._links), 1)
+            with self._cv:
+                link.draining = link.draining or (
+                    header.get("reason") == "shutting_down"
+                )
+                if not exhausted and job.status == "dispatching":
+                    self._requeue_locked(job, frm=link.aid,
+                                         reason=str(header.get("reason")))
+                    self._persist_locked()
                 self._cv.notify_all()
             self._flush_persist()
-            self._publish_gauges()
+            if exhausted:
+                self._finish_error(job, ControllerClosed(
+                    f"job {jid} rejected by every agent "
+                    f"({header.get('reason')})"
+                ))
+            time.sleep(0.05)
+            return
+        # The agent accepted: transition to inflight (the routing trace
+        # was already journaled by the dispatcher, in DRR order).
+        with self._cv:
+            if job.status != "dispatching":
+                # The result beat us here: the job is already finished
+                # — never resurrect it as inflight or re-occupy the
+                # slot its completion just freed.
+                return
+            if not link.alive:
+                # The agent died between the accepted reply and here
+                # (its _agent_down saw the job still mid-dispatch and
+                # re-queued nothing): treat as agent loss ourselves —
+                # at-least-once, never a stranded inflight on a dead
+                # link that no later path would revisit.
+                self._requeue_locked(job, frm=link.aid,
+                                     reason="agent_lost")
+            else:
+                job.status = "inflight"
+                job.agent = link.aid
+                link.inflight.add(jid)
+            self._persist_locked()
+            self._cv.notify_all()
+        self._flush_persist()
+        self._publish_gauges()
 
     # -- completion ----------------------------------------------------------
 
@@ -916,9 +1051,12 @@ class FleetController:
     def _discard_inflight_locked(self, jid: str) -> None:
         """Free ``jid``'s outstanding slot on EVERY link (caller holds
         ``_cv``): after a reroute a job may be recorded on a different
-        link than the one delivering its result."""
+        link than the one delivering its result — including a lane's
+        pending list it never left."""
         for l in self._links.values():
             l.inflight.discard(jid)
+            if jid in l.pending:
+                l.pending.remove(jid)
 
     def _drop_spool(self, jid: str) -> None:
         spool = self._spool_path(jid)
@@ -998,8 +1136,14 @@ class FleetController:
         with self._cv:
             return {
                 "queued": self._policy.queue_depth,
+                # in_flight keeps its §12 meaning: ACCEPTED and running
+                # on an agent; lane-held jobs surface separately.
                 "in_flight": sum(
                     1 for j in self._jobs.values() if j.status == "inflight"
+                ),
+                "dispatching": sum(
+                    1 for j in self._jobs.values()
+                    if j.status == "dispatching"
                 ),
                 "done": self._done_jobs,
                 "failed": self._failed_jobs,

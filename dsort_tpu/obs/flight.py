@@ -183,7 +183,13 @@ class FlightRecorder:
         try:
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(bundle, f, default=str)
-            os.replace(tmp, path)  # atomic: a reader never sees a torn bundle
+                f.flush()
+                # fsync before the atomic rename: a postmortem bundle
+                # exists precisely because something is failing — it must
+                # survive the host going down right after, and a reader
+                # must never see a torn bundle.
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
         except OSError as e:
             # The recorder is a diagnostic surface: a full disk must not
             # take the recovering job down with it.

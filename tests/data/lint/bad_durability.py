@@ -1,0 +1,38 @@
+"""Deliberate durability-discipline violations (DS701/DS702/DS703)."""
+
+import json
+import os
+import threading
+
+import numpy as np
+
+
+class BadPersist:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+
+    def save_state_torn(self, path):
+        # DS701: raw write to the final path — a crash mid-write leaves a
+        # torn state file where the restart path expects a whole one.
+        with open(path, "w") as f:
+            json.dump(self.state, f)
+
+    def save_shard_unsynced(self, path, arr):
+        tmp = path + ".tmp"
+        np.save(tmp, arr)
+        os.replace(tmp, path)  # DS702: rename with no fsync before it
+
+    def bump(self):
+        with self._lock:
+            self.state["seq"] = self.state.get("seq", 0) + 1
+
+    def persist_under_lock(self, path):
+        # DS703 x3: snapshot AND write while holding the shared state lock
+        # — disk latency serializes every other holder.
+        with self._lock:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.state, f)
+                os.fsync(f.fileno())
+            os.replace(tmp, path)

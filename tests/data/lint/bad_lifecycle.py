@@ -1,0 +1,33 @@
+"""Deliberate kernel/thread lifecycle violations (DS901/DS902/DS903)."""
+
+import threading
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def kernel_forgot_wait(src, dst, sems, p):
+    def copy(k):
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst, send_sem=sems[0].at[k],
+            recv_sem=sems[1].at[k], device_id=k,
+        )
+
+    for k in range(1, p):
+        copy(k).start()  # DS901: never waited — in flight at kernel end
+
+
+def kernel_half_drained(src, dst, sems):
+    def copy(k):
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst, send_sem=sems[0].at[k],
+            recv_sem=sems[1].at[k], device_id=k,
+        )
+
+    copy(1).start()
+    copy(1).wait_recv()  # DS902: the send semaphore is never drained
+
+
+def spawn_workers(fn):
+    threading.Thread(target=fn).start()  # DS903: not daemon, never joined
+    t = threading.Thread(target=fn)  # DS903
+    t.start()

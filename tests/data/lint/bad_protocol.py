@@ -1,0 +1,28 @@
+"""Deliberate protocol-coverage violations (DS801/DS802/DS803)."""
+
+from dsort_tpu.fleet.proto import send_frame
+from dsort_tpu.serve.admission import Admission
+
+
+def send_unregistered(sock):
+    send_frame(sock, {"type": "frobnicate", "job_id": "j1"})  # DS801
+
+
+def dead_branch(header):
+    return header.get("type") == "not_a_frame"  # DS801
+
+
+def dispatch(header, payload):
+    # DS802: a dispatch chain with no default — every registered frame
+    # type outside the two arms falls through silently.
+    ftype = header["type"]
+    if ftype == "hello":
+        return "hi"
+    elif ftype == "ping":
+        return "pong"
+
+
+def verdicts(v):
+    if v.reason == "totally_bogus":  # DS803
+        return "?"
+    return Admission(False, "nope", "t", 0, 0)  # DS803

@@ -1,0 +1,56 @@
+"""Near-miss clean twin of bad_durability.py: tmp+fsync+rename, the touch
+idiom, snapshot-under-lock + write-outside, and a dedicated flush lock."""
+
+import json
+import os
+import threading
+
+import numpy as np
+
+
+class GoodPersist:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self.state = {}
+        self._pending = None
+
+    def save_state(self, path):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def save_shard(self, path, arr):
+        tmp = path + ".tmp.npy"
+        np.save(tmp, arr)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+
+    def preallocate(self, path):
+        open(path, "wb").close()  # create/truncate writes no payload
+
+    def bump(self):
+        with self._lock:
+            self.state["seq"] = self.state.get("seq", 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            self._pending = dict(self.state)
+
+    def flush(self, path):
+        with self._lock:  # cheap dict work only under the shared lock
+            pending = self._pending
+        # The dedicated single-function flush lock is the sanctioned shape.
+        with self._flush_lock:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(pending, f)
+                os.fsync(f.fileno())
+            os.replace(tmp, path)

@@ -1,0 +1,43 @@
+"""Near-miss clean twin of bad_lifecycle.py: the ring kernel's
+start/fold/wait schedule with BOTH DMA directions drained, a plain-wait
+copy, a daemon thread, and joined worker threads."""
+
+import threading
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def kernel_paired(src, dst, sems, p):
+    def copy(k):
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst, send_sem=sems[0].at[k],
+            recv_sem=sems[1].at[k], device_id=k,
+        )
+
+    copy(1).start()
+    for k in range(2, p):
+        copy(k).start()
+        copy(k - 1).wait_recv()
+    copy(p - 1).wait_recv()
+    for k in range(1, p):
+        copy(k).wait_send()  # every DMA drained before buffer reuse
+
+
+def kernel_plain_wait(src, dst, sem):
+    c = pltpu.make_async_remote_copy(
+        src_ref=src, dst_ref=dst, send_sem=sem, recv_sem=sem, device_id=0,
+    )
+    c.start()
+    c.wait()
+
+
+def spawn_daemon(fn):
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def run_joined(fn, n):
+    threads = [threading.Thread(target=fn) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()

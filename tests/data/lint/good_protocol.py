@@ -1,0 +1,33 @@
+"""Near-miss clean twin of bad_protocol.py: registered frame types, an
+explicit dispatch default, a reply guard, registered admission reasons."""
+
+from dsort_tpu.fleet.proto import send_frame
+from dsort_tpu.serve.admission import Admission
+
+
+def send_submit(sock, payload):
+    send_frame(sock, {"type": "submit", "job_id": "j1"}, payload)
+
+
+def dispatch(header, payload):
+    ftype = header["type"]
+    if ftype == "hello":
+        return "hi"
+    elif ftype == "ping":
+        return "pong"
+    else:  # explicit default: one-directional frames raise loudly
+        raise ValueError(ftype)
+
+
+def reply_guard(frame):
+    # A lone equality test is a guard for one expected reply type, not a
+    # dispatch surface.
+    if frame.get("type") == "welcome":
+        return True
+    return False
+
+
+def verdicts(v):
+    if v.reason == "queue_full":
+        return "backoff"
+    return Admission(True, "admitted", "t", 1, 1)

@@ -1,0 +1,5 @@
+import fakebackend.core  # the forbidden module-level import
+
+
+def work(x):
+    return fakebackend.core.run(x)

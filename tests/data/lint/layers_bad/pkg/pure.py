@@ -1,0 +1,3 @@
+"""Declared backend-free — but helper pulls the backend at import time."""
+
+from pkg.helper import work  # noqa: F401

@@ -1,0 +1,10 @@
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import fakebackend.core  # annotation-only: never executes
+
+
+def work(x):
+    import fakebackend.core  # lazy: the sanctioned escape hatch
+
+    return fakebackend.core.run(x)

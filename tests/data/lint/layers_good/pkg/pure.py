@@ -1,0 +1,3 @@
+"""Backend-free: the helper defers its backend import."""
+
+from pkg.helper import work  # noqa: F401

@@ -355,6 +355,133 @@ def test_agent_loss_reroutes_inflight_job():
         a.close(drain=False)
 
 
+class _StalledAgent:
+    """A stuck-but-connected agent: completes the hello/welcome handshake,
+    answers pings, then swallows the first submit and never replies again.
+    Accepts exactly ONE connection (reconnects fail) so the post-stall
+    routing is deterministic."""
+
+    def __init__(self, variants=()):
+        self.variants = list(variants)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(1)
+        self.addr = "127.0.0.1:%d" % self._listener.getsockname()[1]
+        self.got_submit = threading.Event()
+        self._conns = []
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        try:
+            conn, _ = self._listener.accept()
+        except OSError:
+            return
+        self._conns.append(conn)
+        self._listener.close()
+        try:
+            while True:
+                frame = proto.recv_frame(conn)
+                if frame is None:
+                    return
+                header, _ = frame
+                if header["type"] == "hello":
+                    proto.send_frame(conn, {
+                        "type": "welcome", "agent_id": "stall",
+                        "capacity": 1, "big_jobs": False, "draining": False,
+                        "variants": self.variants, "jobs": {},
+                    })
+                elif header["type"] == "ping" and not self.got_submit.is_set():
+                    proto.send_frame(conn, {
+                        "type": "heartbeat", "queued": 0, "in_flight": 0,
+                        "draining": False, "variants": self.variants,
+                        "capacity": 1,
+                    })
+                elif header["type"] == "submit":
+                    self.got_submit.set()  # swallow; never reply again
+        except (proto.ProtocolError, OSError):
+            pass
+
+    def close(self):
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def test_stalled_agent_does_not_stall_fleet_dispatch():
+    """ISSUE 13 satellite (the ROADMAP-named stall): one stuck-but-
+    connected agent must not stall fleet-wide dispatch.  Dispatch runs on
+    per-agent lanes with a bounded per-agent send deadline
+    (``dispatch_timeout_s``): the healthy agent's jobs flow immediately
+    while the stalled lane waits out its deadline, and the swallowed job
+    then fails over to the healthy agent."""
+    journal = EventLog()
+    d_stall = np.arange(1000, dtype=np.int32)[::-1].copy()
+    d_ok = np.arange(2000, dtype=np.int32)[::-1].copy()
+    # The stalled agent alone advertises the first job's rung: locality
+    # routes that job onto it deterministically.
+    stalled = _StalledAgent(
+        variants=[proto.fused_rung_prefix(len(d_stall), "int32") + "lax"]
+    )
+    healthy = FleetAgent(runner=_sort_runner, agent_id="H")
+    ctl = FleetController(
+        [stalled.addr, healthy.addr],
+        # A LIVE heartbeat: the health plane must not serialize behind the
+        # stuck lane's request slot either (LaneBusy skip) — pings to the
+        # healthy agent keep flowing throughout the stall.
+        heartbeat_s=0.3,
+        request_timeout_s=30,    # the OLD fleet-wide stall bound — never paid
+        dispatch_timeout_s=4.0,  # the bounded per-agent send deadline
+        journal=journal,
+    )
+    try:
+        v, stuck = ctl.submit(d_stall, tenant="t")
+        assert v.admitted
+        assert stalled.got_submit.wait(10), "job never routed to the stall"
+        # The healthy agent's jobs dispatch and complete WHILE the stalled
+        # lane is still inside its send deadline — the old synchronous
+        # dispatcher would have serialized them behind the stuck submit
+        # for up to request_timeout_s.
+        t0 = time.monotonic()
+        tickets = [ctl.submit(d_ok, tenant="t")[1] for _ in range(3)]
+        for t in tickets:
+            np.testing.assert_array_equal(
+                t.result(timeout=10), np.sort(d_ok)
+            )
+        healthy_took = time.monotonic() - t0
+        assert healthy_took < 4.0, (
+            f"healthy jobs took {healthy_took:.1f}s — dispatch stalled "
+            "behind the stuck agent"
+        )
+        # At the deadline the stalled agent is failed over and the
+        # swallowed job completes on the healthy agent.
+        np.testing.assert_array_equal(
+            stuck.result(timeout=30), np.sort(d_stall)
+        )
+        rr = [e for e in journal.events() if e.type == "job_rerouted"]
+        assert rr and rr[0].fields["reason"] in (
+            "dispatch_failed", "agent_lost"
+        )
+        # The trace is honest: the swallowed job was routed to the stall
+        # first, re-routed at the deadline, and every completed dispatch
+        # names the healthy agent.
+        routed = [
+            e.fields["agent"] for e in journal.events()
+            if e.type == "job_routed"
+        ]
+        assert routed[0] == "stall" and routed.count("H") == 4
+    finally:
+        stalled.close()
+        ctl.kill()
+        healthy.close(drain=False)
+
+
 # -- the controller-restart drill (ISSUE 12 acceptance) ----------------------
 
 
@@ -681,15 +808,20 @@ def test_fleet_config_keys():
         "FLEET_STATE_DIR": "/tmp/fleet",
         "FLEET_ROUTING": "random",
         "FLEET_HEARTBEAT_S": "0.5",
+        "FLEET_DISPATCH_TIMEOUT_S": "4.5",
     })
     assert cfg.fleet.agents == ("h1:9200", "h2:9200")
     assert cfg.fleet.state_dir == "/tmp/fleet"
     assert cfg.fleet.routing == "random"
     assert cfg.fleet.heartbeat_s == 0.5
+    assert cfg.fleet.dispatch_timeout_s == 4.5
+    assert SortConfig.from_mapping({}).fleet.dispatch_timeout_s is None
     with pytest.raises(ConfigError, match="routing"):
         FleetConfig(routing="mystery")
     with pytest.raises(ConfigError, match="heartbeat"):
         FleetConfig(heartbeat_s=0)
+    with pytest.raises(ConfigError, match="dispatch_timeout"):
+        FleetConfig(dispatch_timeout_s=0)
     with pytest.raises(ConfigError, match="HOST:PORT"):
         FleetConfig(agents=("nocolon",))
 

@@ -103,6 +103,64 @@ def test_prof_fixture():
     assert run_fixture("good_prof.py") == []
 
 
+def test_durability_checker_fixture():
+    """ISSUE 13: the PR 12 review-fix classes stay pinned — a raw write to
+    a persisted-state path, a rename with no fsync, and persist IO under a
+    shared lock; the clean twin carries the full tmp+fsync+rename idiom,
+    the touch idiom, and the dedicated-flush-lock shape."""
+    from dsort_tpu.analysis.checkers.durability import DurabilityChecker
+
+    scoped = [DurabilityChecker(scope=("*.py",))]
+    diags = run_fixture("bad_durability.py", checkers=scoped)
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS701": 1, "DS702": 1, "DS703": 3}
+    assert run_fixture("good_durability.py", checkers=scoped) == []
+
+
+def test_protocol_checker_fixture():
+    """ISSUE 13: frame vocabulary + dispatch coverage — an unregistered
+    send/compare, a no-default dispatch chain, unregistered admission
+    reasons; the clean twin has an explicit default and a reply guard."""
+    from dsort_tpu.analysis.checkers.protocol import ProtocolChecker
+
+    scoped = [ProtocolChecker(scope=("*.py",))]
+    diags = run_fixture("bad_protocol.py", checkers=scoped)
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS801": 2, "DS802": 1, "DS803": 2}
+    missing = [d for d in diags if d.code == "DS802"][0]
+    # the coverage report names what actually falls through
+    assert "'result'" in missing.message and "'submit'" in missing.message
+    assert run_fixture("good_protocol.py", checkers=scoped) == []
+
+
+def test_lifecycle_checker_fixture():
+    """ISSUE 13: the fused-ring DMA pairing contract — a started-never-
+    waited copy, a half-drained copy — and thread daemon/join discipline;
+    the clean twin is the real kernel's start/fold/wait schedule."""
+    diags = run_fixture("bad_lifecycle.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS901": 1, "DS902": 1, "DS903": 2}
+    assert run_fixture("good_lifecycle.py") == []
+
+
+def test_layers_checker_fixtures():
+    """ISSUE 13 tentpole: the declared-pure module reaching a forbidden
+    backend transitively is flagged WITH the import chain (DS601), a
+    layer pattern naming a dead module is loud (DS602); the clean twin's
+    lazy + TYPE_CHECKING imports pass."""
+    bad_root = fixture("layers_bad")
+    diags = lint_paths([os.path.join(bad_root, "pkg")], load_config(bad_root))
+    assert codes_of(diags) == ["DS601", "DS602"]
+    chain = diags[0]
+    assert chain.path == "pkg/helper.py" and chain.line == 1
+    assert "pkg.pure -> pkg.helper -> fakebackend.core" in chain.message
+    assert "pkg.missing_module" in diags[1].message
+    good_root = fixture("layers_good")
+    assert lint_paths(
+        [os.path.join(good_root, "pkg")], load_config(good_root)
+    ) == []
+
+
 def test_exceptions_checker_fixture():
     # Fixtures live outside the checker's recovery-path scope: rescope.
     scoped = [ExceptionsChecker(scope=("*.py",))]
@@ -175,6 +233,7 @@ def test_checker_catalog_is_documented():
     catalog = checker_catalog()
     assert set(catalog) == {
         "registry", "concurrency", "tracing", "exceptions", "compat",
+        "layers", "durability", "protocol", "lifecycle",
     }
     arch = open(os.path.join(REPO, "ARCHITECTURE.md"), encoding="utf-8").read()
     for codes in catalog.values():
@@ -365,6 +424,222 @@ def test_abba_not_reported_across_distinct_class_locks(tmp_path):
     diags = lint_paths([str(src)], LintConfig(root=REPO))
     assert codes_of(diags) == ["DS203"]  # only the shared-global inversion
     assert "GA" in diags[0].message and "GB" in diags[0].message
+
+
+# -- ISSUE 13: import-graph / layers ----------------------------------------
+
+
+def test_import_graph_synthetic_package(tmp_path):
+    """Unit-level contract of the cross-file import resolver: relative
+    imports, `from pkg import submodule`, parent-__init__ execution, and
+    TYPE_CHECKING exclusion."""
+    from dsort_tpu.analysis.checkers.layers import ImportGraph
+
+    pkg = tmp_path / "app"
+    sub = pkg / "inner"
+    sub.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("from app import base\n")
+    (pkg / "base.py").write_text(
+        "from typing import TYPE_CHECKING\n"
+        "from . import util\n"
+        "if TYPE_CHECKING:\n"
+        "    import typing_only_backend\n"
+    )
+    (pkg / "util.py").write_text("import forbidden_backend.core\n")
+    (sub / "__init__.py").write_text("")
+    (sub / "leaf.py").write_text("from ..util import thing\n")
+    graph = ImportGraph(str(tmp_path))
+    assert graph.resolve("app") == ("app/__init__.py", True)
+    assert graph.resolve("app.base") == ("app/base.py", False)
+    assert graph.resolve("app.nope") is None
+    assert graph.expand("app.*") == [
+        "app", "app.base", "app.inner", "app.inner.leaf", "app.util",
+    ]
+    # relative `from . import util` resolves to app + app.util
+    deps = {n for n, _ in graph.module_imports("app.base")}
+    assert deps == {"typing", "app", "app.util"}  # TYPE_CHECKING excluded
+    # two-dot relative from a nested module
+    deps = {n for n, _ in graph.module_imports("app.inner.leaf")}
+    assert "app.util" in deps
+    # the checker end-to-end: one DS601 with the full chain
+    from dsort_tpu.analysis.checkers.layers import LayersChecker
+
+    cfg = LintConfig(
+        root=str(tmp_path), layers={"app.base": ("forbidden_backend",)}
+    )
+    diags = lint_paths([str(pkg)], cfg, checkers=[LayersChecker()])
+    assert codes_of(diags) == ["DS601"]
+    assert "app.base -> app.util -> forbidden_backend.core" in diags[0].message
+
+
+def test_layer_map_names_existing_modules():
+    """ISSUE 13 CI gate (b): every [tool.dsort.lint.layers] pattern in THE
+    pyproject resolves to at least one existing module — a renamed module
+    cannot silently un-declare its purity contract."""
+    from dsort_tpu.analysis.checkers.layers import ImportGraph
+
+    cfg = load_config(REPO)
+    assert cfg.layers, "the layers table vanished from pyproject.toml"
+    graph = ImportGraph(REPO)
+    for pattern in cfg.layers:
+        assert graph.expand(pattern), (
+            f"layers pattern {pattern!r} matches no module — update "
+            "pyproject.toml to follow the rename"
+        )
+    # The §12 contracts specifically must stay declared.
+    assert "dsort_tpu.fleet.proto" in cfg.layers
+    assert "dsort_tpu.fleet.controller" in cfg.layers
+    assert "dsort_tpu.serve.policy" in cfg.layers
+
+
+def test_seeded_layer_violation_is_caught(tmp_path):
+    """THE static purity gate: seeding a module-level `import jax` into a
+    module the fleet controller reaches at import time fails `dsort lint`
+    — no subprocess, no backend (the jax-blocked subprocess test in
+    test_fleet.py stays as the dynamic backstop)."""
+    pkg = tmp_path / "dsort_tpu"
+    shutil.copytree(os.path.join(REPO, "dsort_tpu"), pkg,
+                    ignore=shutil.ignore_patterns("*.so", "selftest*",
+                                                  "__pycache__"))
+    shutil.copy(os.path.join(REPO, "pyproject.toml"),
+                tmp_path / "pyproject.toml")
+    fair = pkg / "serve" / "fair.py"
+    fair.write_text("import jax\n" + fair.read_text())
+    cfg = load_config(str(tmp_path))
+    cfg.baseline = None
+    diags = [d for d in lint_paths([str(pkg)], cfg) if d.code == "DS601"]
+    assert diags, "seeded jax import escaped the layer checker"
+    # Both the directly-declared module and the fleet controller (which
+    # reaches serve.fair through serve.policy) report the breach.
+    msgs = "\n".join(d.message for d in diags)
+    assert "dsort_tpu.fleet.controller" in msgs
+    assert all(d.path == "dsort_tpu/serve/fair.py" for d in diags)
+
+
+# -- ISSUE 13: result cache + --changed -------------------------------------
+
+
+class _CountingChecker:
+    """Minimal checker observing how often the engine really runs it."""
+
+    name = "counting"
+    codes = {"DS998": "test probe"}
+    scope = ("*.py",)
+    project = False
+
+    def __init__(self):
+        self.calls = 0
+
+    def matches(self, relpath):
+        return relpath.endswith(".py")
+
+    def check(self, ctx):
+        self.calls += 1
+        if "seeded_violation" in ctx.source:
+            from dsort_tpu.analysis import Diagnostic
+
+            return [Diagnostic(ctx.relpath, 1, 0, "DS998", "seeded")]
+        return []
+
+
+def test_lint_cache_hits_and_invalidates(tmp_path):
+    """ISSUE 13 satellite: the per-file result cache is keyed by content
+    hash — unchanged files never re-lint, an edited file's stale entry is
+    dropped, and a changed checker set invalidates the whole cache."""
+    src = tmp_path / "mod.py"
+    src.write_text("x = 1\n")
+    cache = str(tmp_path / "cache.json")
+    cfg = LintConfig(root=str(tmp_path))
+    probe = _CountingChecker()
+    assert lint_paths([str(src)], cfg, checkers=[probe], cache_path=cache) == []
+    assert probe.calls == 1
+    # warm: the cached entry is served, the checker never runs
+    assert lint_paths([str(src)], cfg, checkers=[probe], cache_path=cache) == []
+    assert probe.calls == 1
+    # edit -> stale entry dropped, finding surfaces
+    src.write_text("x = 1  # seeded_violation\n")
+    diags = lint_paths([str(src)], cfg, checkers=[probe], cache_path=cache)
+    assert probe.calls == 2 and codes_of(diags) == ["DS998"]
+    # warm again on the NEW content
+    diags = lint_paths([str(src)], cfg, checkers=[probe], cache_path=cache)
+    assert probe.calls == 2 and codes_of(diags) == ["DS998"]
+    # a different checker set cannot serve the old entries
+    other = _CountingChecker()
+    other.name = "counting2"
+    lint_paths([str(src)], cfg, checkers=[other], cache_path=cache)
+    assert other.calls == 1
+
+
+def test_lint_cache_invalidates_on_registry_edit(tmp_path):
+    """Editing a registry SOURCE invalidates cached per-file results —
+    otherwise deleting an event type could leave stale 'clean' entries."""
+    pkg = tmp_path / "proj"
+    pkg.mkdir()
+    reg = pkg / "events.py"
+    reg.write_text("EVENT_TYPES = {'alpha': 'x'}\nCOUNTERS = {}\n")
+    mod = pkg / "mod.py"
+    mod.write_text("def f(m):\n    m.emit('alpha')\n")
+    cfg = LintConfig(root=str(pkg), registry_path="events.py",
+                     native_map_path="events.py")
+    cache = str(pkg / "cache.json")
+    assert [
+        d for d in lint_paths([str(mod)], cfg, cache_path=cache)
+        if d.code == "DS101"
+    ] == []
+    reg.write_text("EVENT_TYPES = {'beta': 'x'}\nCOUNTERS = {}\n")
+    diags = lint_paths([str(mod)], cfg, cache_path=cache)
+    assert [d for d in diags if d.code == "DS101"], (
+        "stale cache served a clean verdict against the edited registry"
+    )
+
+
+def test_cli_lint_changed_scopes_to_git_diff(tmp_path):
+    """`dsort lint --changed` lints exactly the files changed vs HEAD
+    (plus untracked), and reports cleanly when nothing changed."""
+    from dsort_tpu import cli
+
+    def git(*argv):
+        subprocess.run(
+            ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+             "-c", "user.name=t", *argv],
+            check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    tracked = tmp_path / "tracked.py"
+    tracked.write_text("y = 2\n")
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+    # nothing changed -> loudly scoped to zero files, exit 0
+    assert cli.main(["lint", "--root", str(tmp_path), "--changed",
+                     "--no-cache"]) == 0
+    # change one tracked file (violation) + add an untracked clean one
+    tracked.write_text("def f(m):\n    m.bump('never_registered_x')\n")
+    (tmp_path / "fresh.py").write_text("z = 3\n")
+    rc = cli.main(["lint", "--root", str(tmp_path), "--changed",
+                   "--no-cache"])
+    assert rc == 1  # the changed file's DS102 fails the run
+    # explicit paths and --changed are mutually exclusive
+    with pytest.raises(SystemExit, match="exclusive"):
+        cli.main(["lint", "--root", str(tmp_path), "--changed",
+                  str(clean)])
+
+
+def test_protocol_registry_config_error_is_loud(tmp_path):
+    """DS804 mirrors DS105: a misconfigured proto/admission registry path
+    is a finding, never a silently-empty vocabulary."""
+    from dsort_tpu.analysis.checkers.protocol import ProtocolChecker
+
+    cfg = LintConfig(root=str(tmp_path), proto_registry_path="nope/proto.py",
+                     admission_registry_path="nope/admission.py")
+    src = tmp_path / "x.py"
+    src.write_text("from dsort_tpu.fleet.proto import send_frame\n")
+    diags = lint_paths(
+        [str(src)], cfg, checkers=[ProtocolChecker(scope=("*.py",))]
+    )
+    assert codes_of(diags) == ["DS804", "DS804"]
 
 
 # -- native event round trip (registry <-> C++ <-> drain parser) ------------
