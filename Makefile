@@ -32,7 +32,7 @@ serve-smoke:  ## mixed small/large two-tenant workload through the real serving 
 	$(PY) -m dsort_tpu.cli bench --serve-mixed --n 400000 --reps 1 \
 	--journal /tmp/dsort_serve_smoke.jsonl
 
-fleet-smoke:  ## federated serving: 2 local agents behind a fleet controller, locality-vs-random routing A/B (8-device cpu mesh)
+fleet-smoke:  ## federated serving: 2 local agents behind a fleet controller, locality/random/health routing A/B + telemetry-overhead baseline (8-device cpu mesh)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m dsort_tpu.cli bench --fleet-mixed --n 20000 --reps 1 \
 	--journal /tmp/dsort_fleet_smoke.jsonl
@@ -55,6 +55,9 @@ NEW ?= BENCH_r06.jsonl
 bench-compare:  ## diff two bench artifacts: make bench-compare OLD=a NEW=b [STRICT=1]
 	$(PY) bench.py --compare $(OLD) $(NEW) $(if $(STRICT),--strict,)
 
+bench-history:  ## the whole in-tree BENCH_r*.jsonl perf trajectory as one metric x PR table
+	$(PY) bench.py --history
+
 native:  ## build libdsort_native.so
 	$(MAKE) -C $(NATIVE)
 
@@ -72,4 +75,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke profile-smoke external-smoke bench-compare native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke profile-smoke external-smoke bench-compare bench-history native tsan asan ubsan sanitize

@@ -125,6 +125,10 @@ BENCH_SCHEMA_FIELD_TYPES = {
     "cache_hit_rate_random": "num",
     "speedup_vs_random": "num",
     "rerouted": "num",
+    # Health-plane rows (`dsort bench --fleet-mixed` health arm, ISSUE 14):
+    "telemetry_overhead_frac": "num",
+    "health_verdicts": "num",
+    "speedup_vs_locality": "num",
 }
 
 _SCHEMA_TYPE_CHECKS = {
@@ -323,6 +327,111 @@ def _compare_main(argv: list[str]) -> int:
     }), flush=True)
     bad = counts.get("severe", 0) + (counts.get("regression", 0) if strict else 0)
     return 1 if bad else 0
+
+
+# -- perf trajectory (`bench.py --history [DIR]`) ----------------------------
+#
+# The in-tree BENCH_r*.jsonl artifacts record one bench session per PR;
+# until now the trajectory across them was only reconstructable by hand
+# (pairwise --compare runs).  --history consolidates them into ONE
+# metric x artifact table, classifying each consecutive step on the same
+# tolerance ladder --compare uses.  Backend-free, like --check.
+
+_HISTORY_GLOB = "BENCH_r*.jsonl"
+
+
+def history_artifacts(root: str) -> list[str]:
+    """In-tree ``BENCH_r*.jsonl`` artifacts, oldest first (by the rNN
+    number, then name — previews sort with their round)."""
+    import glob as _glob
+    import re as _re
+
+    def round_of(path):
+        m = _re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else 0, os.path.basename(path))
+
+    return sorted(
+        _glob.glob(os.path.join(root, _HISTORY_GLOB)), key=round_of
+    )
+
+
+def history_rows(root: str) -> dict:
+    """The consolidated trajectory: ``{"artifacts": [names...],
+    "metrics": {metric: {artifact: {"value", "unit"}}},
+    "steps": {metric: [{"frm", "to", "ratio", "class"}...]}}``.
+
+    Steps classify CONSECUTIVE appearances of a metric (they may skip
+    artifacts — a metric benched in r07 and r12 classifies r07->r12) on
+    the `COMPARE_LADDER`, rate units only; non-rate units report
+    ``info``.
+    """
+    paths = history_artifacts(root)
+    names = [os.path.basename(p) for p in paths]
+    metrics: dict[str, dict] = {}
+    for path, name in zip(paths, names):
+        for metric, obj in _artifact_metrics(path).items():
+            metrics.setdefault(metric, {})[name] = {
+                "value": obj.get("value"), "unit": obj.get("unit"),
+            }
+    steps: dict[str, list] = {}
+    for metric, per in metrics.items():
+        seen = [n for n in names if n in per]
+        for frm, to in zip(seen, seen[1:]):
+            o, n = per[frm], per[to]
+            row = {"frm": frm, "to": to}
+            if (
+                n.get("unit") in _RATE_UNITS
+                and o.get("unit") == n.get("unit")
+                and o.get("value")
+            ):
+                ratio = float(n["value"]) / float(o["value"])
+                row["ratio"] = round(ratio, 3)
+                row["class"] = classify_ratio(ratio)
+            else:
+                row["class"] = "info"
+            steps.setdefault(metric, []).append(row)
+    return {"artifacts": names, "metrics": metrics, "steps": steps}
+
+
+def _history_main(argv: list[str]) -> int:
+    """``bench.py --history [DIR]``: print the metric x PR trajectory."""
+    root = argv[0] if argv else os.path.dirname(os.path.abspath(__file__))
+    if len(argv) > 1:
+        print("usage: bench.py --history [DIR]", file=sys.stderr)
+        return 2
+    hist = history_rows(root)
+    if not hist["artifacts"]:
+        print(f"no {_HISTORY_GLOB} artifacts under {root}", file=sys.stderr)
+        return 2
+    cols = [n.replace("BENCH_", "").replace(".jsonl", "")
+            for n in hist["artifacts"]]
+    head = f"{'metric':<52}" + "".join(f"{c:>14}" for c in cols)
+    print(head)
+    print("-" * len(head))
+    worst: dict[str, int] = {}
+    for metric in sorted(hist["metrics"]):
+        per = hist["metrics"][metric]
+        cells = []
+        for name in hist["artifacts"]:
+            v = per.get(name, {}).get("value")
+            cells.append(f"{v:>14.4g}" if isinstance(v, (int, float))
+                         else f"{'-':>14}")
+        marks = "".join(
+            {"ok": "", "info": "", "noise": "~",
+             "regression": "!", "severe": "!!"}.get(s["class"], "")
+            for s in hist["steps"].get(metric, ())
+        )
+        print(f"{(metric + (' ' + marks if marks else ''))[:52]:<52}"
+              + "".join(cells))
+        for s in hist["steps"].get(metric, ()):
+            worst[s["class"]] = worst.get(s["class"], 0) + 1
+    print(json.dumps({
+        "metric": "history_summary",
+        "artifacts": hist["artifacts"],
+        "metrics": len(hist["metrics"]),
+        "classes": worst,
+    }), flush=True)
+    return 0
 
 
 def _ensure_responsive_backend() -> None:
@@ -1459,4 +1568,6 @@ if __name__ == "__main__":
         sys.exit(_check_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "--compare":
         sys.exit(_compare_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--history":
+        sys.exit(_history_main(sys.argv[2:]))
     sys.exit(main())

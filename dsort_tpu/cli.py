@@ -760,6 +760,8 @@ def cmd_fleet(args) -> int:
         fleet_cfg = dataclasses.replace(
             fleet_cfg, dispatch_timeout_s=args.dispatch_timeout
         )
+    if getattr(args, "no_health_telemetry", False):
+        fleet_cfg = dataclasses.replace(fleet_cfg, telemetry=False)
     agents = getattr(args, "agents", None) or ",".join(fleet_cfg.agents)
     if not agents:
         raise SystemExit(
@@ -790,6 +792,8 @@ def cmd_fleet(args) -> int:
         journal=journal,
         journal_path=getattr(args, "journal", None),
         telemetry=telemetry,
+        health_telemetry=fleet_cfg.telemetry,
+        flight_dir=cfg.job.flight_recorder_dir,
     )
     if controller.stats()["agents"] == 0:
         log.warning(
@@ -1581,6 +1585,14 @@ def _bench_fleet_mixed(args, cfg: SortConfig) -> int:
     ``np.sort``.  Fairness (p95 queue-wait ratio across tenants, from
     the controller journal's ``job_dequeued`` records) must hold the
     same 3x bound the PR 7 serving layer is tested to.
+
+    ISSUE 14 adds two arms: a heartbeats-only locality baseline (health
+    telemetry off) whose elapsed-time ratio against the locality arm is
+    ``telemetry_overhead_frac`` (the <5% live-telemetry contract), and a
+    ``routing="health"`` arm emitting its own
+    ``fleet_mixed_health_routing_2agents`` row (rolling verdict count,
+    hit rate, speedup vs locality) — the drilled route-around-a-straggler
+    behavior lives in ``tests/test_health.py``.
     """
     import dataclasses
     import tempfile
@@ -1626,29 +1638,31 @@ def _bench_fleet_mixed(args, cfg: SortConfig) -> int:
     )
     journal = _open_journal(args) or EventLog()
 
-    def run_arm(routing: str, arm_journal, td: str):
+    def run_arm(routing: str, arm_journal, td: str, name: str,
+                telemetry_on: bool = True):
         agents = [
             FleetAgent(
                 service=SortService(
                     devices=devs[:half], job=cfg.job, serve=serve_cfg
                 ),
-                agent_id=f"{routing}-a",
+                agent_id=f"{name}-a",
             ),
             FleetAgent(
                 service=SortService(
                     devices=devs[half:], job=cfg.job, serve=serve_cfg
                 ),
-                agent_id=f"{routing}-b",
+                agent_id=f"{name}-b",
             ),
         ]
         ctl = FleetController(
             [ag.addr for ag in agents],
-            state_dir=os.path.join(td, routing),
+            state_dir=os.path.join(td, name),
             max_queue_depth=serve_cfg.max_queue_depth,
             max_tenant_inflight=serve_cfg.max_tenant_inflight,
             routing=routing,
             heartbeat_s=0.5,
             journal=arm_journal,
+            health_telemetry=telemetry_on,
         )
         try:
             t0 = time.perf_counter()
@@ -1672,23 +1686,56 @@ def _bench_fleet_mixed(args, cfg: SortConfig) -> int:
                 hits += st["hits"]
                 misses += st["misses"]
             hit_rate = hits / (hits + misses) if (hits + misses) else 0.0
-            return dt, ok, hit_rate, rerouted
+            verdicts = sum(
+                1 for e in arm_journal.events() if e.type == "health_verdict"
+            )
+            return dt, ok, hit_rate, rerouted, verdicts
         finally:
             ctl.shutdown(drain=True)
             for ag in agents:
                 ag.close()
 
-    rand_journal = EventLog()
+    rand_journal, health_journal = EventLog(), EventLog()
+    reps = max(getattr(args, "reps", 1), 1)
     with tempfile.TemporaryDirectory() as td:
-        dt_rand, ok_rand, hit_rand, _ = run_arm("random", rand_journal, td)
-        dt_loc, ok_loc, hit_loc, rerouted = run_arm("locality", journal, td)
+        # The random arm runs FIRST and warms process-wide compile caches,
+        # so the arms after it compare on an equal (warm) footing — in
+        # particular the telemetry-overhead pair below.
+        dt_rand, ok_rand, hit_rand, _, _ = run_arm(
+            "random", rand_journal, td, "random"
+        )
+        # Heartbeats-only baseline vs the live health plane: identical
+        # locality workload, telemetry opt-in the ONLY difference — the
+        # ratio is the overhead the <5% contract binds on.  Min-of-reps
+        # on BOTH sides (the bench doctrine): the per-frame work is tiny
+        # and a single elapsed sample is scheduler-noise-dominated.
+        dt_hb = dt_loc = None
+        ok_hb = ok_loc = True
+        for i in range(reps):
+            dt, ok, _, _, _ = run_arm(
+                "locality", EventLog(), td, f"hb-only{i}",
+                telemetry_on=False,
+            )
+            ok_hb = ok_hb and ok
+            dt_hb = dt if dt_hb is None else min(dt_hb, dt)
+            dt, ok, hit_loc, rerouted, _ = run_arm(
+                "locality", journal, td, f"locality{i}"
+            )
+            ok_loc = ok_loc and ok
+            dt_loc = dt if dt_loc is None else min(dt_loc, dt)
+        dt_health, ok_health, hit_health, _, verdicts = run_arm(
+            "health", health_journal, td, "health"
+        )
     try:
         if getattr(args, "journal", None):
             journal.flush_jsonl(args.journal)
     except OSError as e:
         log.warning("fleet-mixed journal write failed: %s", e)
     p95, fairness = _queue_fairness(journal.events(), tenants)
-    ok = ok_rand and ok_loc and hit_loc > hit_rand
+    ok = (
+        ok_rand and ok_loc and ok_hb and ok_health and hit_loc > hit_rand
+        and verdicts > 0
+    )
     jobs_total = len(small_jobs) + 1
     print(json.dumps({
         "metric": "fleet_mixed_workload_2agents",
@@ -1703,7 +1750,20 @@ def _bench_fleet_mixed(args, cfg: SortConfig) -> int:
         "fairness_p95_ratio": round(fairness, 2),
         "speedup_vs_random": round(dt_rand / dt_loc, 2),
         "rerouted": rerouted,
+        "telemetry_overhead_frac": round(dt_loc / dt_hb - 1.0, 4),
         "bit_identical": ok_rand and ok_loc,
+    }), flush=True)
+    print(json.dumps({
+        "metric": "fleet_mixed_health_routing_2agents",
+        "value": round(jobs_total / dt_health, 2),
+        "unit": "jobs/sec",
+        "jobs": jobs_total,
+        "tenants": len(tenants),
+        "agents": 2,
+        "cache_hit_rate": round(hit_health, 3),
+        "health_verdicts": verdicts,
+        "speedup_vs_locality": round(dt_loc / dt_health, 2),
+        "bit_identical": ok_health,
     }), flush=True)
     return 0 if ok else 1
 
@@ -2546,9 +2606,16 @@ def main(argv=None) -> int:
                    help="persist the control-plane state here so a "
                         "controller restart loses no job (conf "
                         "FLEET_STATE_DIR)")
-    p.add_argument("--routing", choices=["locality", "random"],
-                   help="variant-cache-locality routing (default) or the "
-                        "random A/B baseline (conf FLEET_ROUTING)")
+    p.add_argument("--routing", choices=["locality", "random", "health"],
+                   help="variant-cache-locality routing (default), the "
+                        "random A/B baseline, or health — locality for "
+                        "small jobs plus live straggler-penalized big-job "
+                        "placement from the streamed telemetry verdicts "
+                        "(conf FLEET_ROUTING)")
+    p.add_argument("--no-health-telemetry", action="store_true",
+                   help="heartbeats only: do not opt agents into the "
+                        "health plane's bounded delta stream (conf "
+                        "FLEET_TELEMETRY=0)")
     p.add_argument("--dispatch-timeout", type=float,
                    help="per-agent send deadline in seconds: a stuck-but-"
                         "connected agent fails over after this long "

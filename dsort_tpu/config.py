@@ -320,12 +320,17 @@ class FleetConfig:
     host:port,...`` / conf ``FLEET_AGENTS``); ``state_dir`` is where the
     controller persists its restart-safe queue + job table
     (``FLEET_STATE_DIR`` — without it a restart loses queued jobs);
-    ``routing`` picks variant-cache-locality routing or the random A/B
-    baseline (``FLEET_ROUTING``); ``heartbeat_s`` paces the controller's
-    agent pings (``FLEET_HEARTBEAT_S``); ``dispatch_timeout_s`` is the
-    per-agent SEND deadline — how long one agent may sit on a submit
-    before its lane fails it over (``FLEET_DISPATCH_TIMEOUT_S``; None =
-    the controller's request timeout).
+    ``routing`` picks variant-cache-locality routing, the random A/B
+    baseline, or ``health`` — locality for small jobs plus live
+    straggler-penalized big-job placement (``FLEET_ROUTING``);
+    ``heartbeat_s`` paces the controller's agent pings
+    (``FLEET_HEARTBEAT_S``); ``dispatch_timeout_s`` is the per-agent SEND
+    deadline — how long one agent may sit on a submit before its lane
+    fails it over (``FLEET_DISPATCH_TIMEOUT_S``; None = the controller's
+    request timeout); ``telemetry`` opts agents into the health plane's
+    bounded delta stream on the heartbeat cadence (``FLEET_TELEMETRY``;
+    on by default — off = heartbeats-only, the bench's overhead
+    baseline).
     """
 
     agents: tuple[str, ...] = ()
@@ -333,6 +338,7 @@ class FleetConfig:
     routing: str = "locality"
     heartbeat_s: float = 2.0
     dispatch_timeout_s: float | None = None
+    telemetry: bool = True
 
     def __post_init__(self) -> None:
         from dsort_tpu.fleet.proto import ROUTING_POLICIES
@@ -388,7 +394,7 @@ class SortConfig:
         (``EXTERNAL_RUN_ELEMS``, ``EXTERNAL_WAVE_ELEMS``,
         ``EXTERNAL_MESH``) and fleet-plane keys (``FLEET_AGENTS`` —
         ``host:port,host:port`` — ``FLEET_STATE_DIR``, ``FLEET_ROUTING``,
-        ``FLEET_HEARTBEAT_S``).
+        ``FLEET_HEARTBEAT_S``, ``FLEET_TELEMETRY``).
         """
         def geti(key: str, default: int | None) -> int | None:
             return int(m[key]) if key in m else default
@@ -452,6 +458,8 @@ class SortConfig:
                 float(m["FLEET_DISPATCH_TIMEOUT_S"])
                 if m.get("FLEET_DISPATCH_TIMEOUT_S") else None
             ),
+            telemetry=m.get("FLEET_TELEMETRY", "1").strip().lower()
+            not in ("0", "false", "no"),
         )
         return cls(
             mesh=mesh,

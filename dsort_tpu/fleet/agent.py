@@ -40,8 +40,11 @@ from collections import OrderedDict
 DONE_STORE_MAX = 256
 
 from dsort_tpu.fleet.proto import (
+    MAX_ADVERTISED_VARIANTS,
     MAX_FRAME_BYTES,
     ProtocolError,
+    bounded_frame,
+    clock_pair,
     decode_array,
     encode_array,
     recv_frame,
@@ -104,6 +107,13 @@ class FleetAgent:
         self._conn = None
         self._conn_gen = 0
         self._send_lock = threading.Lock()
+        # Health plane: the delta collector is built lazily when a
+        # controller's hello opts in (telemetry=True) — a heartbeats-only
+        # controller pays nothing.  The CURRENT controller's preference
+        # gates the stream: a heartbeats-only controller attaching after
+        # an opted-in one must not keep receiving frames.
+        self._collector = None
+        self._telemetry_on = False
         if self.journal is not None:
             # The merge handshake: one blessed (wall, mono) pair per agent
             # process so `dsort report --merge` aligns this journal's
@@ -129,12 +139,24 @@ class FleetAgent:
 
     def variant_labels(self) -> list[str]:
         """Flat labels of every cached variant + PR 9 ledger entry — the
-        locality-routing advertisement."""
-        labels = {variant_label_of_key(k) for k in self.service.variants.keys()}
+        locality-routing advertisement.  RECENCY order (oldest first) and
+        bounded to `MAX_ADVERTISED_VARIANTS` with eviction-oldest-first: a
+        long-running agent's heartbeat must not inflate with its compile
+        history, and the freshest rungs are the ones locality wants."""
+        cached = [
+            variant_label_of_key(k) for k in self.service.variants.keys()
+        ]  # VariantCache.keys() is LRU order, oldest first
+        seen = set(cached)
         from dsort_tpu.obs.prof import LEDGER
 
-        labels.update(LEDGER.snapshot().keys())
-        return sorted(labels)
+        # Ledger-only labels are historical compiles no longer (or never)
+        # in the cache — OLDER than anything the LRU still holds, so they
+        # rank first and evict first.
+        labels = [
+            label for label in LEDGER.snapshot()  # first-compile order
+            if label not in seen
+        ] + cached
+        return labels[-MAX_ADVERTISED_VARIANTS:]
 
     def _info(self) -> dict:
         st = self.service.stats()
@@ -146,6 +168,10 @@ class FleetAgent:
             "queued": st["queued"],
             "in_flight": st["in_flight"],
             "variants": self.variant_labels(),
+            # Protocol-level clock sync: the controller journals this pair
+            # as a peer `clock_sync` blessing so `dsort report --merge`
+            # aligns the two journals on MONOTONIC clocks.
+            **clock_pair(),
         }
 
     def job_status(self, jid: str) -> str:
@@ -221,9 +247,57 @@ class FleetAgent:
                 # the store for the next attach.
                 return False
 
+    def _enable_telemetry(self) -> None:
+        """Build + wire the health delta collector (idempotent): it taps
+        the service metrics AND every admitted job's metrics
+        (`SortService.job_taps`), so the streamed deltas see exactly the
+        events the agent's journal sees."""
+        if self._collector is not None:
+            return
+        from dsort_tpu.obs.health import HealthDeltaCollector
+
+        collector = HealthDeltaCollector()
+        collector.attach(self.service._svc_metrics)
+        self.service.job_taps.append(collector)
+        self._collector = collector
+
+    def _send_telemetry(self) -> None:
+        """Drain + ship one bounded ``telemetry`` frame (no-op until a
+        controller opted in via hello).  A failed send folds the delta
+        BACK into the collector — the exact running sums must survive a
+        detached controller like held results do, or work completed while
+        disconnected would vanish from the agent's health history."""
+        if self._collector is None or not self._telemetry_on:
+            return
+        delta = self._collector.drain()
+        sent = self._send(bounded_frame({
+            "type": "telemetry", "agent_id": self.agent_id,
+            **clock_pair(), "delta": delta,
+        }))
+        if not sent:
+            self._collector.restore(delta)
+
     def _handle(self, conn, header: dict, payload: bytes) -> None:
         ftype = header["type"]
         if ftype == "hello":
+            # The opt-in follows the CURRENT controller: telemetry=False
+            # stops the stream even if a previous controller enabled it
+            # (the collector keeps accumulating — cheap — so a later
+            # opted-in controller sees the full history).
+            self._telemetry_on = bool(header.get("telemetry"))
+            if self._telemetry_on:
+                self._enable_telemetry()
+            if (
+                self.journal is not None
+                and isinstance(header.get("mono"), (int, float))
+            ):
+                # The symmetric half of the protocol clock sync: bless the
+                # controller's (wall, mono) pair in THIS journal.
+                self.journal.emit(
+                    "clock_sync", source=self.agent_id,
+                    peer=str(header.get("controller_id")),
+                    peer_t=header.get("wall"), peer_mono=header.get("mono"),
+                )
             known = [str(j) for j in header.get("known_jobs", ())]
             statuses = {j: self.job_status(j) for j in known}
             self._send({"type": "welcome", **self._info(), "jobs": statuses})
@@ -235,6 +309,9 @@ class FleetAgent:
                     self._push_result(jid)
         elif ftype == "ping":
             self._send({"type": "heartbeat", **self._info()})
+            # The health plane rides the heartbeat cadence: one bounded
+            # delta frame follows every heartbeat reply.
+            self._send_telemetry()
         elif ftype == "submit":
             self._on_submit(header, payload)
         elif ftype == "result_ack":
@@ -333,6 +410,10 @@ class FleetAgent:
             except OSError:
                 pass
         self._push_result(jid)
+        # A completion is a health-plane edge worth shipping immediately:
+        # the phase seconds this job just accumulated reach the controller
+        # with the result instead of waiting out a heartbeat period.
+        self._send_telemetry()
 
     def _push_result(self, jid: str) -> None:
         with self._lock:

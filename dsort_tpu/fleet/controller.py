@@ -16,10 +16,16 @@ in heartbeats, the controller computes the job's capacity-ladder rung with
 the pure twin `proto.fused_rung`, and a job whose rung is already compiled
 on mesh B prefers mesh B (a sticky affinity map makes the preference
 deterministic even before the first heartbeat refresh).  `routing=
-"random"` is the A/B baseline (`dsort bench --fleet-mixed`).  A draining
-agent takes no new work; a dead agent's in-flight jobs re-enter the queue
-(`job_rerouted`) — spill-over re-routing instead of blocking on a
-re-forming mesh.
+"random"` is the A/B baseline (`dsort bench --fleet-mixed`); `routing=
+"health"` keeps the locality arm for small jobs but places BIG jobs on
+the mesh whose measured straggler profile is cleanest — agents stream
+bounded `telemetry` deltas on the heartbeat cadence, `obs.health.
+HealthAnalyzer` folds them into rolling per-agent why-slow verdicts
+(journaled as typed ``health_verdict`` events, exported as per-agent
+``/metrics`` gauges, rendered by ``dsort top``), and a degraded flip
+dumps a flight bundle (ARCHITECTURE §13).  A draining agent takes no new
+work; a dead agent's in-flight jobs re-enter the queue (`job_rerouted`)
+— spill-over re-routing instead of blocking on a re-forming mesh.
 
 **Restart loses no job** (the unlock): every admission/dispatch/completion
 transition persists the control-plane state (policy snapshot + job table)
@@ -47,6 +53,7 @@ from dsort_tpu.fleet.proto import (
     FLEET_SMALL_JOB_MAX,
     ROUTING_POLICIES,
     ProtocolError,
+    clock_pair,
     decode_array,
     encode_array,
     fused_rung_prefix,
@@ -54,6 +61,7 @@ from dsort_tpu.fleet.proto import (
     recv_frame,
     send_frame,
 )
+from dsort_tpu.obs.health import HealthAnalyzer
 from dsort_tpu.serve.admission import Admission
 from dsort_tpu.serve.policy import ControlPolicy
 from dsort_tpu.utils.logging import get_logger
@@ -180,6 +188,9 @@ class FleetController:
         journal_path: str | None = None,
         telemetry=None,
         controller_id: str | None = None,
+        health_telemetry: bool = True,
+        degraded_score: float = 1.5,
+        flight_dir: str | None = None,
         start: bool = True,
     ):
         if routing not in ROUTING_POLICIES:
@@ -235,6 +246,26 @@ class FleetController:
         self._svc_metrics = Metrics(journal=journal)
         if telemetry is not None:
             telemetry.attach(self._svc_metrics)
+        # The live health plane (ARCHITECTURE §13): agents stream bounded
+        # telemetry deltas on the heartbeat cadence; the analyzer folds
+        # them into rolling per-agent why-slow verdicts the `health`
+        # routing arm and the degraded->flight-bundle contract read.
+        self.health_telemetry = bool(health_telemetry)
+        self.health = HealthAnalyzer(
+            degraded_score=degraded_score, slo_ms=slo_shed_ms,
+        )
+        self._degraded: dict[str, bool] = {}
+        self.flight = None
+        if flight_dir:
+            from dsort_tpu.obs.flight import FlightRecorder
+
+            # Dumps ONLY on degraded flips: the agents' own services keep
+            # their eviction recorders, and the schedulers theirs.
+            self.flight = FlightRecorder(
+                flight_dir, state_fn=self.agent_info,
+                events=frozenset({"agent_degraded"}),
+            )
+            self.flight.attach(self._svc_metrics)
         if self.journal is not None:
             self.journal.emit("clock_sync", source=self.controller_id)
         restored = self._load_state()
@@ -435,6 +466,11 @@ class FleetController:
             send_frame(sock, {
                 "type": "hello", "controller_id": self.controller_id,
                 "known_jobs": known,
+                # Opt the agent into the health-plane delta stream, and
+                # carry our (wall, mono) pair so the agent can journal a
+                # peer clock_sync blessing (monotonic journal alignment).
+                "telemetry": self.health_telemetry,
+                **clock_pair(),
             })
             frame = recv_frame(sock)
             if frame is None or frame[0].get("type") != "welcome":
@@ -446,6 +482,18 @@ class FleetController:
             return False
         sock.settimeout(None)
         first = link.aid is None
+        if (
+            self.journal is not None
+            and isinstance(welcome.get("mono"), (int, float))
+        ):
+            # Protocol clock sync: bless the agent's (wall, mono) pair in
+            # OUR journal so `obs.merge` can rebase that agent's journal
+            # onto this one's monotonic frame without trusting wall clocks.
+            self.journal.emit(
+                "clock_sync", source=self.controller_id,
+                peer=str(welcome["agent_id"]),
+                peer_t=welcome.get("wall"), peer_mono=welcome.get("mono"),
+            )
         with self._cv:
             link.sock = sock
             link.aid = str(welcome["agent_id"])
@@ -457,6 +505,7 @@ class FleetController:
             link.job_statuses = {
                 str(k): str(v) for k, v in dict(welcome.get("jobs", {})).items()
             }
+            self.health.set_active(link.aid, True)
             self._cv.notify_all()
         self._svc_metrics.event(
             "agent_register", agent=link.aid,
@@ -481,6 +530,14 @@ class FleetController:
                 header, payload = frame
                 if header["type"] == "result":
                     self._on_result(link, header, payload)
+                elif header["type"] == "telemetry":
+                    # Async like results: a delta must never be consumed
+                    # as (or discarded with) a request's reply.  A
+                    # heartbeats-only controller IGNORES strays — an
+                    # agent a previous controller opted in must not make
+                    # this one journal verdicts it promised not to.
+                    if self.health_telemetry:
+                        self._on_telemetry(link, header)
                 else:
                     with link._reply_cv:
                         link._replies.append((header, payload))
@@ -565,6 +622,13 @@ class FleetController:
             lost = sorted(link.inflight) + list(link.pending)
             link.inflight.clear()
             link.pending.clear()
+            if link.aid is not None:
+                # A down agent keeps its health history (it may return)
+                # but leaves the fleet-mean/straggler computation — and
+                # its degraded flag: you cannot be the fleet's straggler
+                # while not in the fleet.
+                self.health.set_active(link.aid, False)
+                self._degraded.pop(link.aid, None)
             for jid in lost:
                 job = self._jobs.get(jid)
                 if job is not None and job.status in ("inflight", "dispatching"):
@@ -787,6 +851,19 @@ class FleetController:
 
         if job.n_keys >= FLEET_SMALL_JOB_MAX:
             cands = [l for l in live if l.big_jobs] or live
+            if self.routing == "health":
+                # Health-aware big-job placement: send the full-mesh work
+                # to the mesh whose measured straggler profile is cleanest
+                # — degraded agents last, then by straggler score, then by
+                # load (ROADMAP item 1's named remainder).  Small jobs
+                # below keep their locality stickiness untouched.
+                scores = self.health.scores()
+                if scores:
+                    def penalty(l):
+                        deg, sc = scores.get(l.aid, (False, 0.0))
+                        return (bool(deg), sc) + loaded(l)
+
+                    return min(cands, key=penalty), "health"
             return min(cands, key=loaded), "size"
         if self.routing == "random":
             return self._rng.choice(live), "random"
@@ -1010,6 +1087,57 @@ class FleetController:
         self._flush_persist()
         self._publish_gauges()
 
+    # -- health plane (ARCHITECTURE §13) -------------------------------------
+
+    def _on_telemetry(self, link: _AgentLink, header: dict) -> None:
+        """Fold one agent's streamed delta and journal its refreshed
+        verdict; a degraded FLIP additionally journals ``agent_degraded``
+        (dumping a flight bundle when ``flight_dir`` is set)."""
+        aid = str(header.get("agent_id") or link.aid or link.label())
+        self.health.ingest(aid, header.get("delta") or {})
+        self._svc_metrics.bump("fleet_telemetry_frames")
+        # ONE fleet-wide recompute per frame: the gauge publish below
+        # reuses this dict instead of re-scoring every agent.
+        verdicts = self.health.verdicts()
+        verdict = verdicts.get(aid)
+        if verdict is None:
+            return
+        now = bool(verdict["degraded"])
+        with self._cv:
+            was = self._degraded.get(aid, False)
+            self._degraded[aid] = now
+        self._svc_metrics.bump("health_verdicts")
+        # The typed rolling verdict: one event per ingested delta, so the
+        # journal's LAST health_verdict per agent IS the live final state
+        # (the live==replay drill keys on exactly this).
+        self._svc_metrics.event(
+            "health_verdict",
+            **{k: verdict[k] for k in (
+                "agent", "busy_s", "score", "straggler", "dominant_phase",
+                "splits", "slo_risk", "degraded", "seq",
+            )},
+        )
+        if now and not was:
+            # Emitted OUTSIDE _cv: the flight recorder's dump reads the
+            # fleet state (`agent_info`) which takes the lock itself.
+            self._svc_metrics.bump("agent_degradations")
+            self._svc_metrics.event(
+                "agent_degraded", agent=aid, score=verdict["score"],
+                dominant_phase=verdict["dominant_phase"],
+            )
+            log.warning(
+                "agent %s flipped DEGRADED (%.2fx fleet-mean busy, "
+                "dominant phase %s): health routing penalizes it for big "
+                "jobs", aid, verdict["score"], verdict["dominant_phase"],
+            )
+        elif was and not now:
+            log.warning("agent %s recovered (no longer degraded)", aid)
+        self._publish_gauges(verdicts)
+
+    def health_verdicts(self) -> dict[str, dict]:
+        """The rolling per-agent why-slow verdicts (`obs.health`)."""
+        return self.health.verdicts()
+
     # -- completion ----------------------------------------------------------
 
     def _on_result(self, link: _AgentLink, header: dict, payload: bytes) -> None:
@@ -1119,7 +1247,7 @@ class FleetController:
 
     # -- telemetry / introspection -------------------------------------------
 
-    def _publish_gauges(self) -> None:
+    def _publish_gauges(self, verdicts: dict | None = None) -> None:
         if self.telemetry is None:
             return
         with self._cv:
@@ -1131,6 +1259,38 @@ class FleetController:
         self.telemetry.set_gauge("queue_depth", depth)
         self.telemetry.set_gauge("fleet_agents", agents)
         self.telemetry.set_gauge("fleet_agents_draining", draining)
+        if verdicts is None:
+            verdicts = self.health.verdicts()
+        if verdicts:
+            self.telemetry.set_gauge(
+                "fleet_agents_degraded",
+                sum(1 for v in verdicts.values() if v["degraded"]),
+            )
+            for aid, v in verdicts.items():
+                labels = {"agent": aid}
+                self.telemetry.set_series(
+                    "agent_health_score", labels, v["score"]
+                )
+                self.telemetry.set_series(
+                    "agent_health_degraded", labels,
+                    1.0 if v["degraded"] else 0.0,
+                )
+                self.telemetry.set_series(
+                    "agent_health_busy_ms", labels, v["busy_s"] * 1e3
+                )
+                # Info-style series: the dominant phase / straggler bit
+                # ride as labels (keyed by agent, so a refreshed verdict
+                # REPLACES the stale series instead of accumulating).
+                self.telemetry.set_series(
+                    "agent_health_info",
+                    {
+                        "agent": aid,
+                        "dominant_phase": str(v["dominant_phase"] or "-"),
+                        "straggler": "1" if v["straggler"] else "0",
+                    },
+                    1.0,
+                    key=labels,
+                )
 
     def stats(self) -> dict:
         with self._cv:
@@ -1150,6 +1310,9 @@ class FleetController:
                 "agents": sum(1 for l in self._links.values() if l.alive),
                 "agents_draining": sum(
                     1 for l in self._links.values() if l.alive and l.draining
+                ),
+                "agents_degraded": sum(
+                    1 for d in self._degraded.values() if d
                 ),
             }
 

@@ -36,6 +36,16 @@ MAX_FRAME_BYTES = 1 << 31
 #: raise immediately, never buffer gigabytes waiting for a "header".
 MAX_HEADER_BYTES = 1 << 20
 
+#: Byte budget for one serialized ``telemetry`` frame header (the health
+#: plane's delta stream).  A long-running agent accumulates waits, compile
+#: events and variant keys without bound; the heartbeat plane must not —
+#: `bounded_frame` evicts oldest-first until the frame fits.
+TELEMETRY_BYTE_BUDGET = 6144
+#: Bound on the variant/ledger keys an agent advertises per heartbeat —
+#: eviction oldest-first (the advertisement keeps the MOST RECENTLY used
+#: rungs, which is exactly what locality routing wants).
+MAX_ADVERTISED_VARIANTS = 48
+
 #: THE frame-type registry (controller <-> agent).  Direction noted C->A /
 #: A->C; every frame carries ``type`` plus the fields listed.
 FRAME_TYPES: dict[str, str] = {
@@ -60,6 +70,10 @@ FRAME_TYPES: dict[str, str] = {
                   "agent may drop its copy (job_id)",
     "drain": "C->A: finish in-flight work, accept no more fleet jobs",
     "bye": "C->A: clean detach (the agent keeps running)",
+    "telemetry": "A->C: bounded health-plane delta, piggybacked on the "
+                 "heartbeat cadence and on each result (seq, wall, mono + "
+                 "delta: phase seconds, queue waits, compile events, skew, "
+                 "HBM watermark — the PR 9 analyzer inputs, streamed live)",
 }
 
 
@@ -154,8 +168,11 @@ FLEET_SMALL_JOB_MAX = 1 << 20
 
 #: Controller routing policies (`--routing` / conf ``FLEET_ROUTING``).
 #: Lives here (pure constants) so config validation never has to import
-#: the controller's socket/threading machinery.
-ROUTING_POLICIES = ("locality", "random")
+#: the controller's socket/threading machinery.  ``health`` routes big
+#: jobs around measured stragglers (live telemetry verdicts, obs.health)
+#: while keeping locality stickiness for small jobs; ``random`` is the
+#: A/B baseline.
+ROUTING_POLICIES = ("locality", "random", "health")
 
 
 def fused_rung(n: int) -> int:
@@ -186,6 +203,87 @@ def fused_rung_prefix(n_keys: int, dtype_str: str) -> str:
     matches every advertised fused variant of the job's ladder rung
     regardless of the agent's local kernel choice."""
     return f"fused|{fused_rung(n_keys)}|{dtype_str}|"
+
+
+# -- health-plane frame bounds (telemetry deltas + variant adverts) ----------
+
+
+def clock_pair() -> dict:
+    """One ``(wall, mono)`` pair for protocol-level clock sync: ``hello``/
+    ``welcome``/``heartbeat``/``telemetry`` frames carry it so each side
+    can journal a peer ``clock_sync`` blessing and `obs.merge` aligns
+    controller+agent journals by MONOTONIC clocks — no shared journal
+    file, no trust in the peers' wall clocks."""
+    import time
+
+    return {"wall": round(time.time(), 6), "mono": round(time.monotonic(), 6)}
+
+
+#: ``(path, field)`` lists `bounded_frame` may evict from, CHEAPEST loss
+#: first: recent-wait samples (the exact running sums ride as scalars and
+#: are never evicted), then compile events, then advertised variant keys.
+_EVICTABLE_LISTS = (
+    (("delta", "waits"), "recent wait samples"),
+    (("delta", "compiles"), "recent compile events"),
+    (("variants",), "advertised variant keys"),
+    (("delta", "variants"), "advertised variant keys"),
+)
+
+
+def frame_bytes(header: dict) -> int:
+    return len(json.dumps(header).encode("utf-8"))
+
+
+def bounded_frame(header: dict, budget: int = TELEMETRY_BYTE_BUDGET) -> dict:
+    """Bound one telemetry/heartbeat header to ``budget`` serialized bytes.
+
+    Evicts OLDEST-FIRST (list fronts) from the evictable list fields, then
+    folds the smallest per-phase seconds into an ``other`` bucket (the
+    TOTAL stays exact — only attribution coarsens, and the dominant phase
+    is kept by construction).  The common case (already under budget, the
+    telemetry hot path) returns the CALLER'S dict untouched after one
+    size check; eviction works on a deep copy, so the caller's dict is
+    never mutated.  A frame that cannot fit even after eviction is
+    returned at its minimum size — `send_frame`'s hard header bound still
+    applies.
+    """
+    if frame_bytes(header) <= budget:
+        return header
+    head = json.loads(json.dumps(header))
+
+    def _list_at(path):
+        node = head
+        for p in path[:-1]:
+            node = node.get(p)
+            if not isinstance(node, dict):
+                return None, None
+        lst = node.get(path[-1]) if isinstance(node, dict) else None
+        return (node, path[-1]) if isinstance(lst, list) and lst else (None, None)
+
+    for path, _what in _EVICTABLE_LISTS:
+        while frame_bytes(head) > budget:
+            node, key = _list_at(path)
+            if node is None:
+                break
+            lst = node[key]
+            # Oldest first, in chunks so a huge frame converges quickly.
+            del lst[: max(1, len(lst) // 4)]
+            if not lst:
+                del node[key]
+                break
+        if frame_bytes(head) <= budget:
+            return head
+    phases = (head.get("delta") or {}).get("phases")
+    while (
+        frame_bytes(head) > budget
+        and isinstance(phases, dict) and len(phases) > 2
+    ):
+        floor = min(
+            (p for p in phases if p != "other"),
+            key=lambda p: phases[p],
+        )
+        phases["other"] = phases.get("other", 0.0) + phases.pop(floor)
+    return head
 
 
 def parse_agent_addrs(spec) -> list[tuple[str, int]]:

@@ -31,12 +31,30 @@ PR 9 adds the *why slow* plane (ARCHITECTURE §9):
 - `obs.analyze`: the journal-native why-slow verdict behind ``dsort
   report --analyze`` — phase waterfall with cross-process critical path,
   straggler attribution, queue/compile/execute split, wire bytes, skew.
+
+PR 14 adds the LIVE half of why-slow (ARCHITECTURE §13):
+
+- `obs.health`: the streaming counterpart of `obs.analyze` — fleet agents
+  accumulate bounded telemetry deltas (`HealthDeltaCollector`, a Metrics
+  tap) and ship them over the fleet protocol's ``telemetry`` frames on
+  the heartbeat cadence; the controller's `HealthAnalyzer` folds them
+  into rolling per-agent why-slow verdicts (straggler score, dominant
+  phase, queue/compile/execute split, SLO-breach risk) that drive
+  ``routing="health"``, the per-agent ``/metrics`` gauges, the ``dsort
+  top`` health pane, and the degraded->flight-bundle contract.
 """
 
 from dsort_tpu.obs.analyze import (  # noqa: F401
     VERDICT_KEYS,
     analyze_records,
     format_analysis,
+)
+from dsort_tpu.obs.health import (  # noqa: F401
+    HEALTH_VERDICT_KEYS,
+    SHARED_VERDICT_KEYS,
+    HealthAnalyzer,
+    HealthDeltaCollector,
+    format_health,
 )
 from dsort_tpu.obs.flight import (  # noqa: F401
     BUNDLE_SCHEMA_KEYS,
@@ -70,12 +88,16 @@ __all__ = [
     "BUNDLE_SCHEMA_KEYS",
     "CompileLedger",
     "FlightRecorder",
+    "HEALTH_VERDICT_KEYS",
+    "HealthAnalyzer",
+    "HealthDeltaCollector",
     "LEDGER",
     "LEDGER_EVENT_FIELDS",
     "LatencyHistogram",
     "MemWatch",
     "MetricsServer",
     "RECOVERY_EVENTS",
+    "SHARED_VERDICT_KEYS",
     "SLO_QUANTILES",
     "SLO_STAGES",
     "Telemetry",
@@ -83,6 +105,7 @@ __all__ = [
     "analyze_records",
     "device_memory_snapshot",
     "format_analysis",
+    "format_health",
     "group_rotated",
     "instrument_jit",
     "ledger_from_journal",

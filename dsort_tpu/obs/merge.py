@@ -74,22 +74,93 @@ def wall_mono_offset(records: list[dict]) -> float:
     return float(statistics.median(pairs))
 
 
+def peer_shifts(journals: list[list[dict]], shifts: list[float]) -> list[float]:
+    """Refine wall-derived shifts with PEER clock blessings (fleet runs).
+
+    The fleet protocol carries ``(wall, mono)`` pairs on ``hello``/
+    ``welcome``/``heartbeat`` frames; each side journals the peer's pair as
+    a ``clock_sync`` event with ``peer``/``peer_mono`` fields next to its
+    OWN stamps.  Where journal *k* blesses the peer that identifies
+    journal *j* (its ``clock_sync`` carries ``source == peer``), journal
+    *j*'s shift becomes purely MONOTONIC::
+
+        shift_j = shift_k + (blessing record's mono - peer_mono)
+
+    — the receipt instant in *k*'s frame minus the peer's mono at send, so
+    a live fleet merges correctly even when an agent's WALL clock is
+    skewed (no shared journal file, no NTP trust).  The blessings form a
+    relation graph (symmetric blessings are one edge usable both ways);
+    each connected component is ANCHORED at its lowest journal index
+    (journal 0 when present — the reference frame; otherwise the
+    component's wall-derived shift stands for its anchor) and resolved by
+    BFS, each journal's shift overridden AT MOST ONCE — mutual
+    controller<->agent blessings are a cycle whose redundant edge (one
+    network round-trip of disagreement) is ignored, never accumulated.
+    """
+    sources: dict[str, int] = {}
+    blessings: dict[str, tuple[int, float, float]] = {}
+    for j, recs in enumerate(journals):
+        for r in recs:
+            if r.get("type") != "clock_sync":
+                continue
+            if r.get("source") is not None:
+                sources.setdefault(str(r["source"]), j)
+            if r.get("peer") is not None and isinstance(
+                r.get("peer_mono"), (int, float)
+            ):
+                blessings.setdefault(
+                    str(r["peer"]), (j, float(r["mono"]), float(r["peer_mono"]))
+                )
+    # Edges: shift_j = shift_k + d, traversable both directions.
+    adj: dict[int, list[tuple[int, float]]] = {}
+    for pid, (k, receipt_mono, peer_mono) in blessings.items():
+        j = sources.get(pid)
+        if j is None or j == k:
+            continue
+        d = receipt_mono - peer_mono
+        adj.setdefault(k, []).append((j, d))
+        adj.setdefault(j, []).append((k, -d))
+    shifts = list(shifts)
+    resolved: set[int] = set()
+    for anchor in sorted(adj):
+        if anchor in resolved:
+            continue
+        # The anchor keeps its incoming shift (journal 0's is exact by
+        # definition; a component without journal 0 stays wall-anchored
+        # through its lowest member) and mono alignment spreads outward.
+        resolved.add(anchor)
+        frontier = [anchor]
+        while frontier:
+            k = frontier.pop()
+            for j, d in adj.get(k, ()):
+                if j in resolved:
+                    continue
+                shifts[j] = shifts[k] + d
+                resolved.add(j)
+                frontier.append(j)
+    return shifts
+
+
 def merge_records(journals: list[list[dict]]) -> list[dict]:
     """Merge per-journal record lists into one aligned, re-sequenced trace.
 
     Journal 0's monotonic base is the reference frame; every other
     journal's ``mono`` is shifted by the difference of the wall<->mono
     offsets, so durations WITHIN a journal are exact (mono-derived) and
-    placement ACROSS journals is wall-accurate.  Each record gains
-    ``src`` (its journal index); the merged sequence is time-ordered and
-    ``seq`` is rewritten to the global order.
+    placement ACROSS journals is wall-accurate.  Fleet journals carrying
+    protocol-level peer blessings upgrade to purely monotonic alignment
+    (`peer_shifts`).  Each record gains ``src`` (its journal index); the
+    merged sequence is time-ordered and ``seq`` is rewritten to the
+    global order.
     """
     base = wall_mono_offset(journals[0]) if journals else 0.0
+    shifts = [wall_mono_offset(recs) - base for recs in journals]
+    shifts = peer_shifts(journals, shifts)
     out: list[dict] = []
     for src, recs in enumerate(journals):
         if not recs:
             continue
-        shift = wall_mono_offset(recs) - base
+        shift = shifts[src]
         for r in recs:
             r = dict(r)
             r["src"] = src

@@ -34,6 +34,9 @@ class Telemetry:
         self._jobs: dict[tuple[str, str], int] = defaultdict(int)
         self._in_flight = 0
         self._gauges: dict[str, float] = {"queue_depth": 0.0}
+        # Labeled gauge series keyed by (name, identity labels) — the
+        # per-agent health gauges (ARCHITECTURE §13) ride here.
+        self._series: dict[tuple[str, tuple], tuple[tuple, float]] = {}
         self._slo: dict[tuple[str, str], LatencyHistogram] = {}
         self._admissions: dict[tuple[str, str], int] = defaultdict(int)
 
@@ -61,6 +64,25 @@ class Telemetry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[str(name)] = float(value)
+
+    def set_series(
+        self, name: str, labels: dict, value: float, key: dict | None = None
+    ) -> None:
+        """Set one LABELED gauge sample (``dsort_<name>{labels} value``).
+
+        ``key`` (default: all of ``labels``) identifies the series for
+        replacement — an info-style series whose non-key labels carry
+        current state (the health pane's ``dominant_phase``) REPLACES its
+        stale incarnation instead of accumulating one series per distinct
+        label set.
+        """
+        def flat(d):
+            return tuple(sorted((str(k), str(v)) for k, v in d.items()))
+
+        with self._lock:
+            self._series[(str(name), flat(key if key is not None else labels))] = (
+                flat(labels), float(value)
+            )
 
     def inc_counter(self, name: str, by: int = 1) -> None:
         """Directly bump a session counter (serving-layer events that have
@@ -102,7 +124,8 @@ class Telemetry:
 
         ledger = LEDGER.snapshot()
         with self._lock:
-            return {
+            series_raw = sorted(self._series.items())
+            snap = {
                 "variant_ledger": ledger,
                 "counters": dict(self._counters),
                 "phase_seconds": {
@@ -120,6 +143,11 @@ class Telemetry:
                     f"{t}/{s}": h.snapshot() for (t, s), h in self._slo.items()
                 },
             }
+        snap["series"] = {
+            f"{name}{{{','.join(f'{k}={v}' for k, v in labels)}}}": v2
+            for (name, _key), (labels, v2) in series_raw
+        }
+        return snap
 
     def render_prometheus(self) -> str:
         """The Prometheus text exposition snapshot (scrape body)."""
@@ -132,6 +160,7 @@ class Telemetry:
             jobs = dict(self._jobs)
             in_flight = self._in_flight
             gauges = dict(self._gauges)
+            series = dict(self._series)
             admissions = dict(self._admissions)
             slo = dict(self._slo)
         lines = [
@@ -192,6 +221,15 @@ class Telemetry:
             metric = f"dsort_{name}"
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {gauges[name]:g}")
+        # Labeled gauge series (per-agent health, ARCHITECTURE §13).
+        typed: set[str] = set()
+        for (name, _key), (labels, value) in sorted(series.items()):
+            metric = f"dsort_{name}"
+            if metric not in typed:
+                typed.add(metric)
+                lines.append(f"# TYPE {metric} gauge")
+            body = ",".join(f'{k}="{v}"' for k, v in labels)
+            lines.append(f"{metric}{{{body}}} {value:g}")
         lines.append(
             "# HELP dsort_job_stage_seconds Per-tenant SLO stage latency "
             "quantiles (obs.slo)."

@@ -33,6 +33,36 @@ def _labeled(parsed: dict, metric: str) -> list[tuple[dict, float]]:
     ]
 
 
+def _health_rows(parsed: dict) -> dict[str, dict]:
+    """Per-agent health cells from the controller's labeled gauges
+    (`obs.health` via `Telemetry.set_series`, ARCHITECTURE §13)."""
+    rows: dict[str, dict] = {}
+    for metric, field in (
+        ("dsort_agent_health_score", "score"),
+        ("dsort_agent_health_degraded", "degraded"),
+        ("dsort_agent_health_busy_ms", "busy_ms"),
+    ):
+        for labels, value in _labeled(parsed, metric):
+            rows.setdefault(labels.get("agent", "?"), {})[field] = value
+    for labels, _value in _labeled(parsed, "dsort_agent_health_info"):
+        row = rows.setdefault(labels.get("agent", "?"), {})
+        row["dominant_phase"] = labels.get("dominant_phase", "-")
+        row["straggler"] = labels.get("straggler") == "1"
+    return rows
+
+
+def render_health(parsed: dict) -> list[str]:
+    """The health-pane lines (empty when the scrape has no health plane).
+    One shared table formatter with the verdict-side renderer
+    (`obs.health.health_table`) — the two panes cannot drift."""
+    from dsort_tpu.obs.health import health_table
+
+    rows = _health_rows(parsed)
+    if not rows:
+        return []
+    return ["health:"] + health_table(rows, indent="  ")
+
+
 def render_top(parsed: dict) -> str:
     """The console snapshot for one parsed scrape."""
     lines = []
@@ -41,6 +71,7 @@ def render_top(parsed: dict) -> str:
     lines.append(
         f"jobs in flight: {int(in_flight)}    queue depth: {int(queue)}"
     )
+    lines.extend(render_health(parsed))
     # Compiled-variant cache (serving layer): entries/hits/misses/prewarmed
     # ride as gauges; the hit rate is the headline the operator watches.
     hits = parsed.get(("dsort_variant_cache_hits", ()), 0.0)
@@ -165,6 +196,7 @@ def render_fleet(scrapes: list[tuple[str, dict]]) -> str:
     )
     tot_hits = tot_misses = tot_entries = tot_prewarmed = 0
     admissions: dict[tuple[str, str], int] = {}
+    health_lines: list[str] = []
     for url, parsed in scrapes:
         in_flight = int(parsed.get(("dsort_jobs_in_flight", ()), 0.0))
         queued = int(parsed.get(("dsort_queue_depth", ()), 0.0))
@@ -191,6 +223,10 @@ def render_fleet(scrapes: list[tuple[str, dict]]) -> str:
         for labels, value in _labeled(parsed, "dsort_admissions_total"):
             key = (labels.get("tenant", "?"), labels.get("reason", "?"))
             admissions[key] = admissions.get(key, 0) + int(value)
+        # The controller's per-agent health pane renders in the fleet view
+        # too — it IS the fleet's why-slow summary (after the source rows).
+        health_lines.extend(render_health(parsed))
+    lines.extend(health_lines)
     if admissions:
         lines.append("admissions (fleet-wide):")
         for (tenant, reason) in sorted(admissions):

@@ -166,6 +166,11 @@ class SortService:
             from dsort_tpu.models.pipelines import FUSED_SMALL_JOB_MAX
 
             self._small_max = FUSED_SMALL_JOB_MAX
+        # Extra per-job tap sources: objects with ``.attach(metrics)``
+        # offered every admitted job's Metrics (the fleet agent's health
+        # delta collector rides here — the events that land in the agent's
+        # journal feed the streamed telemetry deltas identically).
+        self.job_taps: list = []
         # Service-level metrics: rejections and lifecycle events that have
         # no per-job Metrics to ride on.
         self._svc_metrics = Metrics(journal=journal)
@@ -250,6 +255,8 @@ class SortService:
             self.telemetry.attach(metrics)
         if self.flight is not None:
             self.flight.attach(metrics)
+        for tap in list(self.job_taps):
+            tap.attach(metrics)
         ticket = JobTicket(data, tenant, job_id, ckpt_job_id, metrics)
         metrics.bump("jobs_admitted")
         metrics.event(

@@ -120,13 +120,23 @@ EVENT_TYPES: dict[str, str] = {
                        "queued, in_flight, draining, variants)",
     "job_routed": "the fleet controller dispatched a job onto an agent "
                   "(job_id, tenant, agent, reason — locality/size/spill/"
-                  "random, n_keys)",
+                  "random/health, n_keys)",
     "job_rerouted": "a routed/in-flight job re-entered the fleet queue "
                     "after its agent drained, died, or forgot it (job_id, "
                     "tenant, frm, reason, readmits)",
     "controller_restore": "a restarted fleet controller restored its "
                           "persisted queue + in-flight state (controller, "
                           "queued, inflight, agents)",
+    # Health plane (obs.health over the fleet protocol, ARCHITECTURE §13):
+    "health_verdict": "the controller's rolling why-slow verdict for one "
+                      "agent, refreshed per ingested telemetry delta "
+                      "(agent, score, straggler, dominant_phase, splits, "
+                      "slo_risk, degraded, seq — obs.health."
+                      "HEALTH_VERDICT_KEYS)",
+    "agent_degraded": "an agent's health verdict flipped degraded — the "
+                      "controller dumps a flight bundle and health routing "
+                      "penalizes it for big jobs (agent, score, "
+                      "dominant_phase)",
     # Out-of-core wave pipeline (models.wave_sort, ARCHITECTURE §10):
     "wave_start": "one input wave entered the mesh pipeline "
                   "(wave, n_keys)",
@@ -202,6 +212,12 @@ COUNTERS: dict[str, str] = {
     "fleet_heartbeats": "controller->agent heartbeat round-trips completed",
     "controller_restores": "fleet controller restarts that restored "
                            "persisted queue/in-flight state",
+    "fleet_telemetry_frames": "health-plane telemetry deltas the controller "
+                              "ingested from its agents",
+    "health_verdicts": "rolling per-agent health verdicts the controller "
+                       "journaled",
+    "agent_degradations": "agent health verdicts that flipped degraded "
+                          "(each dumps one flight bundle)",
     "waves_sorted": "input waves run through the mesh exchange pipeline",
     "wave_runs_resorted": "(wave, run) store entries re-sorted by the "
                           "run-granular resume/repair path",

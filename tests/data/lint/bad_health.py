@@ -1,0 +1,42 @@
+"""Fixture: health-plane discipline violations (DS201/DS202 + DS301).
+
+Models the live health plane's two riskiest shapes: a delta collector /
+analyzer whose rolling state must stay lock-guarded with no blocking work
+under the lock (shipping a telemetry frame is a SOCKET write — holding the
+analyzer lock across it would serialize every concurrently-ingesting
+reader thread behind one slow link), and an instrumented stage that must
+never emit a verdict from inside a traced function (the "busy seconds"
+would become a trace-time constant).
+"""
+
+import threading
+import time
+
+import jax
+
+
+class HealthState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._phase_s = {}
+        self._waits = []
+
+    def fold(self, delta):
+        with self._lock:
+            self._waits.append(delta)
+
+    def fold_racy(self, delta):
+        self._waits.append(delta)  # DS201: guarded attribute, no lock held
+
+    def ship_under_lock(self, sock, frame):
+        with self._lock:
+            time.sleep(0.01)  # DS202: the heartbeat pause, lock held
+            sock.wait()  # DS202: blocking on the link from under the lock
+
+
+@jax.jit
+def verdict_inside_trace(x, metrics):
+    metrics.event("health_verdict", agent="a0", score=2.0)  # DS301
+    t0 = time.perf_counter()  # DS301: the busy timer baked in at trace
+    print("degraded at", t0)  # DS301
+    return x + 1

@@ -1,0 +1,45 @@
+"""Fixture: near-miss clean twin of bad_health — all discipline kept.
+
+The shapes `obs.health` actually ships: lock held only for dict/deque
+state, the frame ship and the verdict emission both OUTSIDE the lock, and
+the verdict computed AROUND the jitted callable, never inside it.
+"""
+
+import threading
+import time
+
+import jax
+
+
+class HealthState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._phase_s = {}
+        self._waits = []
+
+    def fold(self, delta):
+        with self._lock:
+            self._waits.append(delta)
+            self._phase_s[delta["phase"]] = delta["seconds"]
+
+    def drain(self):
+        with self._lock:  # swap the window out under the lock ...
+            waits, self._waits = self._waits, []
+        return {"waits": waits}  # ... the caller ships after it released
+
+    def ship_outside_lock(self, sock, frame):
+        delta = self.drain()  # lock released inside drain
+        sock.send(frame, delta)  # the socket write never holds the lock
+
+
+@jax.jit
+def pure_stage(x):
+    return x + 1
+
+
+def verdict_around_trace(x, metrics):
+    t0 = time.perf_counter()  # host-side busy timer AROUND the traced call
+    y = pure_stage(x)
+    metrics.event("health_verdict", agent="a0",
+                  score=time.perf_counter() - t0)
+    return y

@@ -809,13 +809,18 @@ def test_fleet_config_keys():
         "FLEET_ROUTING": "random",
         "FLEET_HEARTBEAT_S": "0.5",
         "FLEET_DISPATCH_TIMEOUT_S": "4.5",
+        "FLEET_TELEMETRY": "0",
     })
     assert cfg.fleet.agents == ("h1:9200", "h2:9200")
     assert cfg.fleet.state_dir == "/tmp/fleet"
     assert cfg.fleet.routing == "random"
     assert cfg.fleet.heartbeat_s == 0.5
     assert cfg.fleet.dispatch_timeout_s == 4.5
+    assert cfg.fleet.telemetry is False
     assert SortConfig.from_mapping({}).fleet.dispatch_timeout_s is None
+    assert SortConfig.from_mapping({}).fleet.telemetry is True
+    assert SortConfig.from_mapping({"FLEET_ROUTING": "health"}) \
+        .fleet.routing == "health"
     with pytest.raises(ConfigError, match="routing"):
         FleetConfig(routing="mystery")
     with pytest.raises(ConfigError, match="heartbeat"):
@@ -928,22 +933,34 @@ def test_cli_fleet_agent_process_drains_on_sigterm(tmp_path):
 def test_bench_fleet_mixed_gate(capsys):
     """Tier-1 gate for `make fleet-smoke`: 2 real agents over TCP behind
     the controller, locality beating random on the fleet-wide variant-
-    cache hit rate, bit-identical outputs."""
+    cache hit rate, bit-identical outputs — and (ISSUE 14) the health
+    arm's own row with live verdict counts plus the measured
+    telemetry-vs-heartbeats-only overhead on the locality row."""
     from dsort_tpu import cli
 
     rc = cli.main(["bench", "--fleet-mixed", "--n", "20000", "--reps", "1"])
     out = capsys.readouterr().out
-    row = json.loads(
-        [ln for ln in out.splitlines() if ln.startswith("{")][-1]
-    )
+    rows = {
+        r["metric"]: r for r in (
+            json.loads(ln) for ln in out.splitlines() if ln.startswith("{")
+        )
+    }
     assert rc == 0
-    assert row["metric"] == "fleet_mixed_workload_2agents"
+    row = rows["fleet_mixed_workload_2agents"]
     assert row["unit"] == "jobs/sec" and row["value"] > 0
     assert row["bit_identical"] is True
     assert row["agents"] == 2 and row["jobs"] >= 13
     assert row["cache_hit_rate"] > row["cache_hit_rate_random"]
     assert row["fairness_p95_ratio"] > 0
     assert row["rerouted"] == 0
+    # Overhead is recorded at this scale, gated (<5%) on the real-scale
+    # artifact (BENCH_r14.jsonl) where timing is not noise-dominated.
+    assert isinstance(row["telemetry_overhead_frac"], float)
+    health = rows["fleet_mixed_health_routing_2agents"]
+    assert health["unit"] == "jobs/sec" and health["value"] > 0
+    assert health["bit_identical"] is True
+    assert health["health_verdicts"] > 0
+    assert health["cache_hit_rate"] >= 0
 
 
 def test_bench_r12_artifact_checks_and_compares():

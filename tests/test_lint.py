@@ -103,6 +103,18 @@ def test_prof_fixture():
     assert run_fixture("good_prof.py") == []
 
 
+def test_health_fixture():
+    """ISSUE 14: the live health plane's discipline contract — rolling
+    collector/analyzer state stays lock-guarded with the frame ship (a
+    socket write) outside the lock, and no verdict is emitted from inside
+    a traced function (the busy timer would become a trace-time
+    constant)."""
+    diags = run_fixture("bad_health.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS201": 1, "DS202": 2, "DS301": 3}
+    assert run_fixture("good_health.py") == []
+
+
 def test_durability_checker_fixture():
     """ISSUE 13: the PR 12 review-fix classes stay pinned — a raw write to
     a persisted-state path, a rename with no fsync, and persist IO under a
