@@ -47,6 +47,11 @@ external-smoke:  ## out-of-core wave pipeline: 8x-over-budget sort, overlap A/B 
 	$(PY) -m dsort_tpu.cli bench --external-wave --n 262144 --reps 1 \
 	--journal /tmp/dsort_external_smoke.jsonl
 
+coded-smoke:  ## coded-redundancy failure A/B: redundancy=1 vs 2, healthy vs one injected loss, bit-identical gate (8-device cpu mesh)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m dsort_tpu.cli bench --coded-ab --n 131072 --reps 1 \
+	--journal /tmp/dsort_coded_smoke.jsonl
+
 # Regression diff over versioned bench artifacts (tolerance ladder:
 # ok >= 0.95 > noise >= 0.80 > regression >= 0.50 > severe); exits 1 on
 # severe (STRICT=1: also on regression).  Backend-free.
@@ -75,4 +80,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke profile-smoke external-smoke bench-compare bench-history native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke profile-smoke external-smoke coded-smoke bench-compare bench-history native tsan asan ubsan sanitize

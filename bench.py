@@ -129,6 +129,16 @@ BENCH_SCHEMA_FIELD_TYPES = {
     "telemetry_overhead_frac": "num",
     "health_verdicts": "num",
     "speedup_vs_locality": "num",
+    # Coded-redundancy rows (`dsort bench --coded-ab`, ISSUE 15):
+    "throughput_under_failure_ratio": "num",
+    "rerun_failure_ratio": "num",
+    "replica_overhead_frac": "num",
+    "redundancy": "num",
+    "coded_recoveries": "num",
+    "coded_replica_bytes": "num",
+    "recovered_keys": "num",
+    "baseline_keys_per_sec": "num",
+    "rerun_keys_per_sec": "num",
 }
 
 _SCHEMA_TYPE_CHECKS = {
@@ -1318,6 +1328,47 @@ print(json.dumps({
     except Exception as e:  # the ladder must never sink the artifact
         _emit(
             "fleet_mixed_workload_2agents_8dev_cpu_mesh", 0.0, "jobs/sec",
+            baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
+
+    # Coded-redundancy rows (ISSUE 15 / ROADMAP item 3): the same zipf
+    # workload at redundancy=1 vs 2, healthy vs one injected mid-ring
+    # device loss, through SpmdScheduler on the 8-device cpu mesh.  The
+    # uncoded faulted arm pays the re-form-and-re-run hit (the ~0.41x of
+    # config5 above); the coded arm recovers by a LOCAL merge of replica
+    # slots — `throughput_under_failure_ratio` must beat the re-run
+    # baseline, with the healthy-path replica overhead reported alongside.
+    # The harness is `dsort bench --coded-ab` — ONE copy of the contract,
+    # shared with `make coded-smoke`.
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "dsort_tpu.cli", "bench",
+                "--coded-ab", "--n", str(1 << 20), "--reps", "3",
+            ],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        rows = []
+        for ln in r.stdout.strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        for row in rows:
+            row["metric"] += "_8dev_cpu_mesh"
+            _emit_line(row)
+        if not rows:
+            raise RuntimeError(
+                f"coded A/B emitted no rows (rc {r.returncode}): "
+                + (r.stderr.strip().splitlines() or ["no stderr"])[-1][:160]
+            )
+    except Exception as e:  # the ladder must never sink the artifact
+        _emit(
+            "coded_redundancy_failure_zipf_8dev_cpu_mesh", 0.0, "keys/sec",
             baseline=False,
             error=(str(e).splitlines() or [repr(e)])[0][:200],
         )

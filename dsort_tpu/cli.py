@@ -83,6 +83,8 @@ def _load_config(args) -> SortConfig:
         job_over["merge_kernel"] = args.merge_kernel
     if getattr(args, "exchange", None):
         job_over["exchange"] = args.exchange
+    if getattr(args, "redundancy", None):
+        job_over["redundancy"] = args.redundancy
     if getattr(args, "checkpoint_dir", None):
         job_over["checkpoint_dir"] = args.checkpoint_dir
     if getattr(args, "tenant", None):
@@ -163,9 +165,14 @@ def _make_sorter(cfg: SortConfig, mode: str):
             # scheduler path runs even for small jobs — resumability wins
             # over dispatch count there.
             checkpointing = cfg.job.checkpoint_dir and job_id
+            # A coded job (redundancy > 1) must reach the exchange plane:
+            # the fused single-device shortcut has no replica plane, and
+            # silently dropping an explicit availability posture would be
+            # worse than the extra dispatches — same rule as checkpointing.
             if (
                 len(data) < FUSED_SMALL_JOB_MAX
                 and not checkpointing
+                and cfg.job.redundancy <= 1
                 and fused_path_open()
             ):
                 try:
@@ -1201,6 +1208,143 @@ def _bench_exchange_ab(args, cfg: SortConfig) -> int:
     return 0 if ok_all else 1
 
 
+def _bench_coded_ab(args, cfg: SortConfig) -> int:
+    """`dsort bench --coded-ab`: the coded-redundancy failure A/B.
+
+    The `make coded-smoke` target (tier-1-gated) and THE acceptance
+    harness for the coded plane (ARCHITECTURE §14): the SAME zipf workload
+    through `SpmdScheduler` four ways — redundancy=1 vs 2, healthy vs one
+    injected mid-ring device loss.  The uncoded faulted arm recovers by
+    today's re-form-and-re-run (the measured ~2.4x hit of
+    ``config5_zipf_1M_injected_failure``); the coded faulted arm recovers
+    by a LOCAL merge of replica slots — counter-asserted: exactly one
+    ``coded_recoveries`` per faulted sort, zero re-dispatch.  Every arm's
+    output must be bit-identical to ``np.sort``; the rows report
+    ``throughput_under_failure_ratio`` (coded faulted vs uncoded healthy)
+    next to the re-run baseline's ratio and the healthy-path replica
+    overhead (``replica_overhead_frac`` — the availability premium: ~r x
+    exchange wire bytes).  Healthy arms warm once and report min-of-reps;
+    each FAULTED rep runs on a FRESH scheduler (healthy warm pass off the
+    clock) so the timed run pays its true recovery cost — for the re-run
+    arm that is the re-dispatch PLUS the re-formed mesh's recompile
+    (exactly what ``config5_zipf_1M_injected_failure`` measured as the
+    2.4x hit, and exactly what the coded arm structurally avoids).
+    """
+    import jax
+
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.ingest import gen_zipf
+    from dsort_tpu.scheduler import FaultInjector, SpmdScheduler
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        raise SystemExit(
+            "--coded-ab needs a multi-device mesh (there is no replica "
+            "holder on one device); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    # The injected victim must exist on THIS mesh, whatever its size —
+    # device 3 on the canonical 8-device mesh, the last device otherwise
+    # (its r=2 replica holder is device 0, wrapping the ring).
+    victim = min(3, len(devices) - 1)
+    journal = _open_journal(args)
+    data = gen_zipf(args.n, a=1.3, seed=5)
+    expect = np.sort(data)
+    n = len(data)
+
+    def make_sched(red: int):
+        inj = FaultInjector()
+        return inj, SpmdScheduler(
+            devices=devices,
+            job=JobConfig(
+                settle_delay_s=0.01, exchange="ring", redundancy=red,
+                key_dtype=np.int64, local_kernel=cfg.job.local_kernel,
+            ),
+            injector=inj,
+        )
+
+    def run_arm(red: int, fault: bool):
+        times = []
+        m = Metrics(journal=journal)
+        out = None
+        if not fault:
+            _, sched = make_sched(red)
+            sched.sort(data)  # warm the healthy P-device programs
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                out = sched.sort(data, metrics=m)
+                times.append(time.perf_counter() - t0)
+            return float(min(times)), m, out
+        for _ in range(args.reps):
+            # A fresh scheduler per faulted rep: the timed sort pays its
+            # TRUE recovery cost — the re-run arm's re-dispatch includes
+            # the re-formed mesh's recompile (the config5 semantics); the
+            # coded arm never re-dispatches, so it pays only the replica
+            # fetch + local merge.
+            inj, sched = make_sched(red)
+            sched.sort(data)  # healthy warm pass, off the clock
+            inj.fail_once(victim, "ring")
+            t0 = time.perf_counter()
+            out = sched.sort(data, metrics=m)
+            times.append(time.perf_counter() - t0)
+        return float(min(times)), m, out
+
+    try:
+        arms = {}
+        ok_all = True
+        for red, fault in ((1, False), (2, False), (1, True), (2, True)):
+            dt, m, out = run_arm(red, fault)
+            identical = bool(np.array_equal(out, expect))
+            ok_all = ok_all and identical
+            arms[(red, fault)] = {
+                "dt": dt,
+                "identical": identical,
+                "coded_recoveries": m.counters.get("coded_recoveries", 0)
+                // args.reps,
+                "recovered_keys": m.counters.get("coded_recovered_keys", 0)
+                // args.reps,
+                "replica_bytes": m.counters.get("coded_replica_bytes", 0)
+                // args.reps,
+                "mesh_reforms": m.counters.get("mesh_reforms", 0)
+                // args.reps,
+            }
+        h1, h2 = arms[(1, False)], arms[(2, False)]
+        f1, f2 = arms[(1, True)], arms[(2, True)]
+        # Contract: the coded faulted arm recovers locally (one coded
+        # recovery per sort, zero re-sorted keys) — not just fast.
+        ok_all = ok_all and f2["coded_recoveries"] == 1
+        print(json.dumps({
+            "metric": f"coded_redundancy_healthy_zipf_{args.n}",
+            "value": round(n / h2["dt"], 1),
+            "unit": "keys/sec",
+            "baseline_keys_per_sec": round(n / h1["dt"], 1),
+            "replica_overhead_frac": round(
+                max(h2["dt"] - h1["dt"], 0.0) / h1["dt"], 4
+            ),
+            "redundancy": 2,
+            "coded_replica_bytes": h2["replica_bytes"],
+            "bit_identical": h1["identical"] and h2["identical"],
+        }), flush=True)
+        print(json.dumps({
+            "metric": f"coded_redundancy_failure_zipf_{args.n}",
+            "value": round(n / f2["dt"], 1),
+            "unit": "keys/sec",
+            "baseline_keys_per_sec": round(n / h1["dt"], 1),
+            "rerun_keys_per_sec": round(n / f1["dt"], 1),
+            "throughput_under_failure_ratio": round(h1["dt"] / f2["dt"], 3),
+            "rerun_failure_ratio": round(h1["dt"] / f1["dt"], 3),
+            "redundancy": 2,
+            "coded_recoveries": f2["coded_recoveries"],
+            "recovered_keys": f2["recovered_keys"],
+            "mesh_reforms": f2["mesh_reforms"],
+            "includes_reform_and_recompile": True,
+            "bit_identical": all(a["identical"] for a in arms.values()),
+        }), flush=True)
+    finally:
+        _write_journal(journal, args)
+    return 0 if ok_all else 1
+
+
 def _queue_fairness(events, tenants) -> tuple[float, float]:
     """``(p95_wait_s, fairness_p95_ratio)`` from journaled ``job_dequeued``
     records — THE fairness computation both serving benchmarks share.
@@ -1773,6 +1917,19 @@ def cmd_bench(args) -> int:
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if getattr(args, "coded_ab", False):
+        if args.suite or getattr(args, "device_resident", False) or getattr(
+            args, "exchange_ab", False
+        ) or getattr(args, "serve_mixed", False) or getattr(
+            args, "analyze_smoke", False
+        ) or getattr(args, "external_wave", False) or getattr(
+            args, "fleet_mixed", False
+        ):
+            raise SystemExit(
+                "--coded-ab is its own benchmark: run it as a separate "
+                "invocation"
+            )
+        return _bench_coded_ab(args, _load_config(args))
     if getattr(args, "fleet_mixed", False):
         if args.suite or getattr(args, "device_resident", False) or getattr(
             args, "exchange_ab", False
@@ -2107,6 +2264,7 @@ def cmd_external(args) -> int:
                 resume=not args.no_resume,
                 overlap=not getattr(args, "no_overlap", False),
                 exchange=getattr(args, "exchange", None),
+                redundancy=getattr(args, "redundancy", None),
             )
         else:
             from dsort_tpu.models.external_sort import ExternalSort
@@ -2116,6 +2274,15 @@ def cmd_external(args) -> int:
                     "--exchange has no effect without --mesh: the "
                     "single-device external sort has no exchange; add "
                     "--mesh N to run the wave pipeline"
+                )
+            if getattr(args, "redundancy", None) and args.redundancy > 1:
+                # Louder than the --exchange case: a silently-dropped
+                # availability posture would leave the operator believing
+                # device-loss tolerance is active when it is not.
+                log.warning(
+                    "--redundancy has no effect without --mesh: the "
+                    "single-device external sort has no replica plane; "
+                    "add --mesh N to run coded waves"
                 )
             s = ExternalSort(
                 run_elems=run_elems,
@@ -2492,6 +2659,14 @@ def main(argv=None) -> int:
                             "same measured ring schedule as ONE Pallas "
                             "kernel — in-kernel async remote DMAs, P-1 "
                             "dispatches collapsed to one launch)")
+        p.add_argument("--redundancy", type=int,
+                       help="coded redundancy r (default 1 = off): the ring "
+                            "exchange additionally ships every bucket to "
+                            "its destination's r-1 ring successors, so up "
+                            "to r-1 device losses recover by a LOCAL merge "
+                            "of replica slots — zero keys re-sorted, zero "
+                            "re-dispatch (ARCHITECTURE \u00a714; forces the "
+                            "lax ring schedule; conf key REDUNDANCY)")
         p.add_argument("--checkpoint-dir",
                        help="persist per-shard/range progress here; a re-run "
                             "of the same input resumes instead of re-sorting")
@@ -2672,6 +2847,12 @@ def main(argv=None) -> int:
                         "A/B; one JSON line with both fleet-wide variant-"
                         "cache hit rates, fairness ratio and bit-identical "
                         "outputs")
+    p.add_argument("--coded-ab", action="store_true",
+                   help="coded-redundancy failure A/B: the same zipf "
+                        "workload at redundancy=1 vs 2, healthy vs one "
+                        "injected device loss (bit-identical gate); JSON "
+                        "rows with throughput_under_failure_ratio and the "
+                        "healthy-path replica overhead")
     p.add_argument("--external-wave", action="store_true",
                    help="out-of-core wave-pipeline benchmark: sort a "
                         "dataset 8x the per-wave device budget through the "
@@ -2750,6 +2931,11 @@ def main(argv=None) -> int:
                    help="per-wave exchange schedule (wave mode; default "
                         "ring; fused = exchange+merge as one Pallas kernel "
                         "per wave)")
+    p.add_argument("--redundancy", type=int,
+                   help="coded redundancy r for each wave's exchange "
+                        "(default 1 = off): a device lost mid-wave repairs "
+                        "from replica slots instead of a host re-sort — "
+                        "wave_runs_resorted stays 0 (ARCHITECTURE §14)")
     p.add_argument("--spill-dir")
     p.add_argument("--job-id", default="external")
     p.add_argument("--no-resume", action="store_true",

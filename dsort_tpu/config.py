@@ -105,6 +105,14 @@ class JobConfig:
     # kernel (`ops.ring_kernel`): per-step async remote DMAs with the merge
     # folded between them, one launch instead of P-1 dispatches.
     exchange: str = "alltoall"
+    # Coded redundancy (ARCHITECTURE §14, arXiv:1702.04850): r-way bucket
+    # replication across ring successors DURING the exchange, so up to r-1
+    # device losses recover by a local merge of replica slots instead of a
+    # re-run (zero keys re-sorted, zero re-dispatch).  1 = off (today's
+    # re-run posture); r > 1 forces the keys-only lax ring schedule (the
+    # replica plane rides its ppermute steps) and costs ~r x the exchange
+    # wire bytes on the healthy path — the availability premium.
+    redundancy: int = 1
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
     # Per-(src,dst) all_to_all bucket headroom over the ideal n/P split.
@@ -174,6 +182,10 @@ class JobConfig:
             raise ConfigError(
                 "exchange must be 'alltoall', 'ring' or 'fused', got "
                 f"{self.exchange!r}"
+            )
+        if not isinstance(self.redundancy, int) or self.redundancy < 1:
+            raise ConfigError(
+                f"redundancy must be an integer >= 1, got {self.redundancy!r}"
             )
         if self.oversample < 1:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")
@@ -386,7 +398,7 @@ class SortConfig:
         plus framework keys (``NUM_WORKERS``, ``KEY_DTYPE``, ``OVERSAMPLE``,
         ``CAPACITY_FACTOR``, ``PAYLOAD_BYTES``, ``HEARTBEAT_TIMEOUT_S``,
         ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``, ``EXCHANGE``,
-        ``TENANT``, ``FLIGHT_DIR``) and serving-layer keys
+        ``REDUNDANCY``, ``TENANT``, ``FLIGHT_DIR``) and serving-layer keys
         (``SERVE_QUEUE_DEPTH``, ``SERVE_TENANT_INFLIGHT``,
         ``SERVE_SLICE_DEVICES``, ``SERVE_SMALL_JOB_MAX``,
         ``SERVE_WEIGHTS`` — ``tenant=weight,...`` — ``SERVE_PREWARM``,
@@ -411,6 +423,7 @@ class SortConfig:
             local_kernel=m.get("LOCAL_KERNEL", JobConfig.local_kernel),
             merge_kernel=m.get("MERGE_KERNEL", JobConfig.merge_kernel),
             exchange=m.get("EXCHANGE", JobConfig.exchange),
+            redundancy=geti("REDUNDANCY", JobConfig.redundancy),
             oversample=geti("OVERSAMPLE", JobConfig.oversample),
             capacity_factor=float(
                 m.get("CAPACITY_FACTOR", JobConfig.capacity_factor)

@@ -325,6 +325,7 @@ class ExternalWaveSort:
         overlap: bool = True,
         axis_name: str = "w",
         exchange: str | None = None,
+        redundancy: int | None = None,
     ):
         if wave_elems < 2:
             raise ValueError("wave_elems must be >= 2")
@@ -353,16 +354,37 @@ class ExternalWaveSort:
         # (`ops.ring_kernel`), so a wave never leaves the device between
         # partition and spill; "alltoall" is meaningless here (the wave
         # plan IS the measured-histogram ring plan) and maps to "ring".
-        from dsort_tpu.parallel.exchange import resolve_exchange
+        from dsort_tpu.parallel.exchange import (
+            resolve_exchange,
+            resolve_redundancy,
+        )
 
         exch = resolve_exchange(exchange, self.job.exchange, self.num_workers)
         self.exchange = "fused" if exch == "fused" else "ring"
-        #: Test seam between a wave's plan and exchange dispatches — the
-        #: same mid-ring injection point as `SampleSort.fault_hook`.
+        # Coded redundancy (ARCHITECTURE §14): r > 1 ships every wave's
+        # buckets to their r-1 ring successors too, so a device lost
+        # mid-wave is repaired by a LOCAL merge of replica slots — no host
+        # re-sort (`wave_runs_resorted` stays 0) and the pipeline
+        # continues.  The replica plane rides the lax ring only, so a
+        # coded wave overrides exchange="fused" back to "ring".
+        self.redundancy = resolve_redundancy(
+            redundancy, self.job.redundancy, self.num_workers
+        )
+        if self.redundancy > 1 and self.exchange == "fused":
+            log.warning(
+                "redundancy=%d needs the lax ring schedule; coded waves "
+                "override exchange='fused' to 'ring'", self.redundancy,
+            )
+            self.exchange = "ring"
+        #: Test seam around a wave's exchange dispatch — the same mid-ring
+        #: injection point as `SampleSort.fault_hook` (and, like there, a
+        #: CODED wave's hook fires after the exchange: replica placement
+        #: completes with it — `parallel.coded`'s simulation note).
         self.fault_hook = None
         self._plan_cache: dict = {}
         self._ring_cache: dict = {}
         self._fused_cache: dict = {}
+        self._coded_cache: dict = {}
         self._single_cache: dict = {}
 
     # -- compiled programs ---------------------------------------------------
@@ -500,6 +522,50 @@ class ExternalWaveSort:
                 ),
             )
             self._fused_cache[key] = fn
+        return fn
+
+    def _build_coded(self, n_local: int, caps: tuple):
+        """Coded per-wave exchange (`exchange._coded_ring_exchange_shard`):
+        the measured-caps ring schedule plus the replica plane, so a wave
+        surviving a device loss repairs from replica slots instead of a
+        host re-sort.  No donation — a fault needs the wave's merged ranges
+        AND replicas host-fetchable after the dispatch."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from dsort_tpu.obs.prof import instrument_jit
+        from dsort_tpu.parallel.exchange import _coded_ring_exchange_shard
+        from dsort_tpu.utils.compat import shard_map
+
+        key = (n_local, caps)
+        fn = self._coded_cache.get(key)
+        if fn is None:
+            p = self.num_workers
+            body = functools.partial(
+                _coded_ring_exchange_shard,
+                num_workers=p,
+                caps=caps,
+                axis=self.axis,
+                redundancy=self.redundancy,
+                merge_kernel=self.job.merge_kernel,
+                kernel=self.job.local_kernel,
+            )
+            fn = instrument_jit(
+                jax.jit(
+                    shard_map(
+                        body,
+                        mesh=self.mesh,
+                        in_specs=(P(self.axis), P(self.axis), P()),
+                        out_specs=(P(self.axis),) * 5,
+                        check_vma=False,
+                    ),
+                ),
+                key_fn=lambda *a: (
+                    "wave_coded", p, n_local, caps, self.redundancy,
+                    str(a[0].dtype), self.job.local_kernel,
+                ),
+            )
+            self._coded_cache[key] = fn
         return fn
 
     def _build_single(self, n_local: int):
@@ -668,7 +734,22 @@ class ExternalWaveSort:
         def dispatch(w, chunk):
             arr, shards, counts = chunk
             metrics.event("wave_start", wave=w, n_keys=len(arr))
-            return self._dispatch_wave(shards, counts, splitters, metrics, timer)
+            try:
+                return self._dispatch_wave(
+                    shards, counts, splitters, metrics, timer
+                )
+            except Exception as e:  # noqa: BLE001 — coded seam, then repair
+                # A loss in a CODED wave carries the replica snapshot: the
+                # wave completes from replica slots right here — zero runs
+                # re-sorted — and the pipeline moves on (state None skips
+                # retire).  Anything else (incl. an over-budget coded
+                # loss) falls through to the host re-sort repair path.
+                state = getattr(e, "coded_state", None)
+                if state is not None and self._coded_recover_wave(
+                    w, e, state, ckpt, metrics, timer
+                ):
+                    return None
+                raise
 
         def retire(w, chunk, state, save):
             self._retire_wave(w, state, ckpt, metrics, timer, save)
@@ -709,6 +790,7 @@ class ExternalWaveSort:
             LEDGER.drain_to(metrics)
             return merged, np.zeros(1, bool), counts.astype(np.int64)
         fused = self.exchange == "fused"
+        coded = self.redundancy > 1
         shard_spec = NamedSharding(self.mesh, P(self.axis))
         repl = NamedSharding(self.mesh, P())
         planfn = self._build_plan(n_local)
@@ -721,20 +803,49 @@ class ExternalWaveSort:
             hist_h = _np.asarray(jax.device_get(hist)).reshape(p, p)
         LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
-        note = note_fused_plan if fused else note_ring_plan
-        note(
-            metrics, caps, hist_h, n_local, p, shards.dtype.itemsize,
-            self.job.capacity_factor,
-        )
-        if self.fault_hook is not None:
+        if coded:
+            from dsort_tpu.parallel.exchange import note_coded_plan
+
+            note_coded_plan(
+                metrics, caps, hist_h, n_local, p, shards.dtype.itemsize,
+                self.job.capacity_factor, self.redundancy,
+            )
+        else:
+            note = note_fused_plan if fused else note_ring_plan
+            note(
+                metrics, caps, hist_h, n_local, p, shards.dtype.itemsize,
+                self.job.capacity_factor,
+            )
+        if not coded and self.fault_hook is not None:
             self.fault_hook()
         with timer.phase("wave_exchange"):
-            if fused:
+            if coded:
+                codedfn = self._build_coded(n_local, caps)
+                merged, cnts, overflow, reps, rep_lens = codedfn(
+                    xs_sorted, cj, spl
+                )
+            elif fused:
                 fusedfn = self._build_fused(n_local, caps)
                 merged, _, overflow = fusedfn(xs_sorted, cj, spl, hist)
             else:
                 ringfn = self._build_ring(n_local, caps)
                 merged, _, overflow = ringfn(xs_sorted, cj, spl)
+        if coded and self.fault_hook is not None:
+            from dsort_tpu.scheduler.fault import WorkerFailure
+
+            try:
+                self.fault_hook()
+            except WorkerFailure as e:
+                # Replica placement completed with the exchange: snapshot
+                # what the survivors hold so the wave repairs from replica
+                # slots (no host re-sort) — `_coded_recover_wave`.
+                from dsort_tpu.parallel.coded import snapshot_state
+
+                e.coded_state = snapshot_state(
+                    p, self.redundancy, caps, int(hist_h.sum()),
+                    merged, cnts, overflow, reps, rep_lens,
+                )
+                raise
         # Keys landing on each range this wave — derived from the already
         # fetched histogram, so the retire step needs no extra scalar fetch.
         recv_lens = hist_h.sum(axis=0).astype(np.int64)
@@ -785,6 +896,51 @@ class ExternalWaveSort:
             "wave %d repaired: %d/%d runs re-sorted on host (%s)",
             w, len(missing), p, reason,
         )
+
+    def _coded_recover_wave(
+        self, w, exc, state, ckpt, metrics, timer
+    ) -> bool:
+        """Complete wave ``w`` from the coded exchange's replica plane.
+
+        The dead device's range is reconstructed by a LOCAL merge of a
+        survivor's replica slots (`parallel.coded`) and every range lands
+        in the (wave, run) store directly — ``wave_runs_resorted`` stays 0
+        and the pipeline continues with the next wave on the mesh.
+        Returns False — journaling ``coded_budget_exceeded`` — when the
+        losses exceed the redundancy budget; the caller then re-raises
+        into the host re-sort repair path.
+        """
+        from dsort_tpu.parallel.coded import dead_positions, journal_recovery
+
+        positions = dead_positions(exc)
+        rec = journal_recovery(
+            metrics, state, positions, assemble=False, wave=w
+        )
+        if rec is None:
+            log.warning(
+                "wave %d: coded recovery over budget (positions %s at "
+                "redundancy=%d); repairing by host re-sort",
+                w, sorted(positions), state.redundancy,
+            )
+            return False
+        ranges, info = rec
+        p = self.num_workers
+        with timer.phase("wave_spill"):
+            total = 0
+            for r in range(p):
+                run = np.asarray(ranges[r])
+                total += len(run)
+                ckpt.save_wave_run(w, r, run)
+        metrics.bump("waves_sorted")
+        metrics.bump("runs_sorted", p)
+        metrics.event("wave_done", wave=w, runs=p, n_keys=total)
+        log.warning(
+            "wave %d repaired CODED: %d key(s) of %d dead range(s) merged "
+            "from replica slots — zero runs re-sorted",
+            w, info["recovered_keys"], len(positions),
+        )
+        _die_check(w)
+        return True
 
     def _merge_ranges(self, num_waves, n, ckpt, metrics, target) -> None:
         p = self.num_workers

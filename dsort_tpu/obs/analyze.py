@@ -25,6 +25,12 @@ trace, `obs.merge`) into one structured verdict:
 - **skew**: the worst ``skew_report`` (max/mean bucket ratio + the
   predicted overloaded device).
 - **hbm**: the high-water ``hbm_watermark`` and the phase it landed in.
+- **recovery**: the failure-posture split (ARCHITECTURE §14) — what the
+  session's recoveries COST: the coded-local side (``coded_recover``
+  events: recoveries, keys reconstructed from replica slots, replica
+  bytes consumed, recovery wall seconds) vs the re-run side (mesh
+  re-forms, evictions, keys re-sorted by any resume/repair path), with
+  ``path`` naming which posture the session actually took.
 - **waves**: out-of-core wave jobs (`models.wave_sort`) — per-wave spans
   from ``wave_start``/``wave_done`` pairs, which wave GATED completion
   (latest ``wave_done``), the slowest wave, and the run-granular resume
@@ -59,6 +65,7 @@ VERDICT_KEYS = (
     "slowest_job",
     "compiles",
     "waves",
+    "recovery",
 )
 
 
@@ -94,6 +101,13 @@ def analyze_records(
     counters_final: dict[tuple[int, object], dict] = {}
     skew_best: dict | None = None
     hbm_best: dict | None = None
+    coded_recoveries = 0
+    coded_keys = 0
+    coded_replica_bytes = 0
+    coded_wall_s = 0.0
+    coded_budget_exceeded = 0
+    mesh_reforms = 0
+    evictions = 0
     wave_start: dict[tuple[int, object], float] = {}
     wave_span: dict[tuple[int, object], float] = {}
     wave_done_at: dict[tuple[int, object], float] = {}
@@ -139,6 +153,18 @@ def analyze_records(
                     k: v for k, v in r.items()
                     if k not in ("seq", "t", "mono", "type")
                 }
+        elif etype == "coded_recover":
+            coded_recoveries += 1
+            coded_keys += int(r.get("recovered_keys", 0) or 0)
+            coded_replica_bytes += int(r.get("replica_bytes", 0) or 0)
+            w = r.get("wall_s")
+            coded_wall_s += float(w) if isinstance(w, (int, float)) else 0.0
+        elif etype == "coded_budget_exceeded":
+            coded_budget_exceeded += 1
+        elif etype == "mesh_reform":
+            mesh_reforms += 1
+        elif etype == "job_evicted":
+            evictions += 1
         elif etype == "wave_start":
             # Scoped by job ordinal: a session journal (the external-smoke
             # bench, a serve loop) holds MANY wave jobs, and wave ids
@@ -246,6 +272,51 @@ def analyze_records(
     slowest_job = (
         max(finished, key=lambda j: j["duration_s"]) if finished else None
     )
+    # -- recovery: coded-local vs re-run posture (ARCHITECTURE §14) ---------
+    resorted_keys = sum(
+        int(c.get(k, 0))
+        for c in counters_final.values()
+        for k in (
+            "shuffle_resort_keys", "wave_resort_keys",
+            "multihost_resort_keys",
+        )
+    )
+    recovery = None
+    if (
+        coded_recoveries or coded_budget_exceeded or mesh_reforms
+        or evictions or resorted_keys
+    ):
+        # A coded recovery re-forms exactly once per loss, so reforms in
+        # EXCESS of the coded recoveries — like resume-path re-sorts,
+        # budget overruns, or evictions that never completed codedly —
+        # mean a re-run recovery also happened this session.
+        rerun_like = (
+            coded_budget_exceeded > 0
+            or resorted_keys > 0
+            or mesh_reforms > coded_recoveries
+            or (evictions > 0 and coded_recoveries == 0)
+        )
+        if coded_recoveries and rerun_like:
+            path = "mixed"
+        elif coded_recoveries:
+            path = "coded_reconstruct"
+        else:
+            path = "rerun"
+        recovery = {
+            "path": path,
+            "coded": {
+                "recoveries": coded_recoveries,
+                "recovered_keys": coded_keys,
+                "replica_bytes": coded_replica_bytes,
+                "wall_s": round(coded_wall_s, 6),
+                "budget_exceeded": coded_budget_exceeded,
+            },
+            "rerun": {
+                "mesh_reforms": mesh_reforms,
+                "evictions": evictions,
+                "resorted_keys": resorted_keys,
+            },
+        }
     # -- waves: the out-of-core wave pipeline's verdict ---------------------
     waves = None
     if wave_done_at or wave_start or wave_resumed:
@@ -300,6 +371,7 @@ def analyze_records(
         "slowest_job": slowest_job,
         "compiles": ledger,
         "waves": waves,
+        "recovery": recovery,
     }
 
 
@@ -357,6 +429,16 @@ def format_analysis(verdict: dict) -> str:
         lines.append(
             f"  hbm watermark : {hbm['bytes_in_use']:,} bytes in phase "
             f"{hbm['phase']} ({hbm['edge']})"
+        )
+    rec = verdict.get("recovery")
+    if rec:
+        c, rr = rec["coded"], rec["rerun"]
+        lines.append(
+            f"  recovery      : {rec['path']} — coded {c['recoveries']} "
+            f"recovery(ies), {c['recovered_keys']:,} keys from "
+            f"{c['replica_bytes']:,} replica bytes in "
+            f"{c['wall_s'] * 1e3:.1f} ms | re-run {rr['mesh_reforms']} "
+            f"reform(s), {rr['resorted_keys']:,} keys re-sorted"
         )
     wv = verdict.get("waves")
     if wv:

@@ -52,6 +52,7 @@ RECOVERY_EVENTS = frozenset(
         "fused_fallback",            # fused path failed over to the scheduler
         "transient_retry",           # in-place retry on a healthy mesh
         "job_evicted",               # serving layer evicted a job off a slice
+        "coded_recover",             # dead range rebuilt from replica slots
     }
 )
 
@@ -96,6 +97,10 @@ def recovery_path_name(etype: str, fields: dict) -> str:
         return f"{etype}:{fields['kind']}"
     if etype == "mesh_reform" and kind:
         return f"{etype}:{kind}"
+    if etype == "coded_recover":
+        # The coded plane's bundle name (ARCHITECTURE §14): the recovery
+        # was a local reconstruction from replica slots, not a re-run.
+        return "coded_reconstruct"
     return etype
 
 

@@ -64,11 +64,14 @@ __all__ = [
     "ring_step_quantum",
     "ring_wire_bytes",
     "alltoall_wire_bytes",
+    "replica_wire_bytes",
     "dispatches_per_exchange",
     "note_ring_plan",
     "note_fused_plan",
+    "note_coded_plan",
     "note_alltoall_attempt",
     "resolve_exchange",
+    "resolve_redundancy",
     "check_ring_overflow",
     "skew_stats",
 ]
@@ -87,6 +90,21 @@ def resolve_exchange(value: str | None, default: str, num_workers: int) -> str:
             f"exchange must be 'alltoall', 'ring' or 'fused', got {exch!r}"
         )
     return "alltoall" if num_workers == 1 else exch
+
+
+def resolve_redundancy(value: int | None, default: int, num_workers: int) -> int:
+    """THE redundancy resolver, shared by every driver: per-call override >
+    config default, clamped to the mesh size (``r`` copies of a bucket need
+    ``r`` distinct devices).  ``1`` is "uncoded" — the exchange ships each
+    bucket to its owner only; ``r > 1`` additionally ships every bucket to
+    its owner's ``r-1`` ring successors (`parallel.coded`), so up to ``r-1``
+    device losses recover by a LOCAL merge of replica slots instead of a
+    re-run.  A 1-worker mesh is always uncoded (there is no second device
+    to hold a replica)."""
+    red = value if value is not None else default
+    if int(red) != red or red < 1:
+        raise ValueError(f"redundancy must be an integer >= 1, got {red!r}")
+    return min(int(red), max(int(num_workers), 1))
 
 
 def dispatches_per_exchange(exchange: str, num_workers: int) -> int:
@@ -329,6 +347,54 @@ def note_fused_plan(
             "fused_exchange_step", step=k, cap=int(caps[k]),
             bytes=int(caps[k]) * bytes_per_slot * p * jobs,
         )
+
+
+def replica_wire_bytes(
+    caps, bytes_per_slot: int, num_workers: int, redundancy: int
+) -> int:
+    """Bytes the coded replica plane adds to the wire (whole mesh).
+
+    For each successor shift ``j`` (1..r-1) every device re-ships its step-k
+    bucket at ring shift ``k+j``; the slot where ``(k+j) % P == 0`` lands on
+    the sender itself and never crosses a link — the replica twin of the
+    ring's "step 0 stays local" rule."""
+    p = num_workers
+    total = 0
+    for j in range(1, redundancy):
+        total += sum(int(caps[k]) for k in range(p) if (k + j) % p != 0)
+    return int(total * bytes_per_slot * p)
+
+
+def note_coded_plan(
+    metrics, caps, hist, n_local: int, num_workers: int, bytes_per_slot: int,
+    capacity_factor: float, redundancy: int, jobs: int = 1,
+) -> None:
+    """Journal one planned CODED ring schedule (`parallel.coded`).
+
+    The coded exchange runs the exact measured-caps ring schedule — the
+    shared accounting (`note_ring_plan`: ``skew_report``, ``exchange_step``,
+    the wire/saved counters) rides unchanged — plus the replica plane:
+    every bucket additionally ships to its destination's ``r-1`` ring
+    successors, priced at the SAME per-step caps.  Replica traffic charges
+    ``exchange_bytes_on_wire`` (it crosses the links like any shipment) AND
+    the dedicated ``coded_replica_bytes`` counter, and one
+    ``coded_replica_ship`` event records the plane's shape so the analyzer
+    can split replica overhead from primary exchange traffic.
+    """
+    p = num_workers
+    note_ring_plan(
+        metrics, caps, hist, n_local, p, bytes_per_slot, capacity_factor,
+        jobs=jobs,
+    )
+    rb = replica_wire_bytes(caps, bytes_per_slot, p, redundancy) * jobs
+    metrics.bump("exchange_bytes_on_wire", rb)
+    metrics.bump("coded_replica_bytes", rb)
+    metrics.event(
+        "coded_replica_ship",
+        redundancy=redundancy,
+        slots=(redundancy - 1) * p,
+        bytes=rb,
+    )
 
 
 # -- shard-level building blocks (run under shard_map) ----------------------
@@ -575,6 +641,62 @@ def _ring_exchange_shard(
 
         merged = sort_with_kernel(jnp.concatenate(tower), kernel)[:total]
     return merged, out_count[None], overflow[None]
+
+
+def _coded_ring_exchange_shard(
+    xs, count, splitters, *, num_workers, caps, axis, redundancy,
+    merge_kernel="auto", kernel="lax",
+):
+    """Coded exchange phase, keys only: the measured-caps ring schedule of
+    `_ring_exchange_shard` PLUS the replica plane of Coded TeraSort
+    (arXiv:1702.04850): every bucket additionally ships to its
+    destination's ``redundancy-1`` ring successors, so device ``m`` leaves
+    the exchange holding, next to its own merged range, one replica buffer
+    per predecessor ``m-j`` (j = 1..r-1) whose slot ``k`` is the sorted
+    sentinel-padded bucket source ``(m-j-k) % P`` sent toward range
+    ``m-j`` — exactly the receive layout the dead device's own merge would
+    have consumed.  Losing any ``r-1`` non-adjacent devices therefore
+    costs a LOCAL merge of a survivor's replica slots, not a re-run.
+
+    Returns ``(merged, out_count, overflow, replicas, replica_lens)``:
+    ``replicas`` is ``(r-1, sum(caps))`` per device (slot ``k`` at the
+    caps-cumsum offset), ``replica_lens`` is ``(r-1, P)`` valid lengths.
+    Replica buckets reuse the plan-measured per-step caps: the bucket
+    ``(src, dst)`` re-shipped at shift ``k+j`` is the SAME bucket the
+    primary schedule moves at step ``k = (dst-src) % P``, so its measured
+    diagonal max — and its overflow detection — are already covered.
+    """
+    p = num_workers
+    merged, out_count, overflow = _ring_exchange_shard(
+        xs, count, splitters, num_workers=p, caps=caps, axis=axis,
+        merge_kernel=merge_kernel, kernel=kernel,
+    )
+    c = count[0]
+    me = jax.lax.axis_index(axis)
+    starts, lens = _bucket_bounds(xs, c, splitters)
+    reps, rep_lens = [], []
+    for j in range(1, redundancy):
+        runs, rlens = [], []
+        for k in range(p):
+            row = (me + jnp.int32(k)) % p
+            blk, _, _ = _bucket_gather(xs, starts, lens, row, caps[k])
+            shift = (k + j) % p
+            if shift == 0:
+                # The holder IS the source: the replica stays on-chip.
+                recv, recv_len = blk, lens[row]
+            else:
+                perm = _ring_perm(p, shift)
+                recv = jax.lax.ppermute(blk, axis, perm)
+                recv_len = jax.lax.ppermute(lens[row][None], axis, perm)[0]
+            # Received at loop index k: source (me-j-k)'s bucket for range
+            # (me-j) — replica slot k of predecessor j's range.
+            runs.append(recv)
+            rlens.append(recv_len)
+        reps.append(jnp.concatenate(runs))
+        rep_lens.append(jnp.stack(rlens).astype(jnp.int32))
+    return (
+        merged, out_count, overflow, jnp.stack(reps), jnp.stack(rep_lens)
+    )
 
 
 def _ring_exchange_kv_shard(

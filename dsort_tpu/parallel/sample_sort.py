@@ -430,6 +430,13 @@ class SampleSort:
 
         return resolve_exchange(exchange, self.job.exchange, self.num_workers)
 
+    def _resolve_redundancy(self, redundancy: int | None) -> int:
+        from dsort_tpu.parallel.exchange import resolve_redundancy
+
+        return resolve_redundancy(
+            redundancy, self.job.redundancy, self.num_workers
+        )
+
     @functools.lru_cache(maxsize=32)
     def _build(
         self, n_local: int, cap_pair: int, kv_trailing: tuple, secondary: bool = False
@@ -603,6 +610,42 @@ class SampleSort:
         )
 
     @functools.lru_cache(maxsize=32)
+    def _build_coded(self, n_local: int, caps: tuple, redundancy: int):
+        """Coded ring exchange (`exchange._coded_ring_exchange_shard`): the
+        measured-caps ring schedule PLUS the replica plane — every bucket
+        additionally ships to its destination's ``redundancy-1`` ring
+        successors, so a lost device's range survives as sorted replica
+        slots on its successors (`parallel.coded`).  Same plan, same caps
+        ladder as `_build_ring`; only built for ``redundancy > 1``.  No
+        donation yet: the coded plane is exercised on the cpu mesh today
+        (XLA CPU ignores donation) — revisit the sorted-keys alias with
+        the ICI port."""
+        from dsort_tpu.parallel.exchange import _coded_ring_exchange_shard
+
+        fn = functools.partial(
+            _coded_ring_exchange_shard,
+            num_workers=self.num_workers,
+            caps=caps,
+            axis=self.axis,
+            redundancy=redundancy,
+            merge_kernel=self.job.merge_kernel,
+            kernel=self.job.local_kernel,
+        )
+        return instrument_jit(
+            jax.jit(
+                shard_map(
+                    fn, mesh=self.mesh,
+                    in_specs=(P(self.axis), P(self.axis), P()),
+                    out_specs=(P(self.axis),) * 5, check_vma=False,
+                ),
+            ),
+            key_fn=lambda *a: (
+                "spmd_coded", self.num_workers, n_local, caps, redundancy,
+                str(a[0].dtype), self.job.local_kernel,
+            ),
+        )
+
+    @functools.lru_cache(maxsize=32)
     def _build_fused(
         self, n_local: int, caps: tuple, kv_trailing: tuple | None = None
     ):
@@ -653,7 +696,8 @@ class SampleSort:
         )
 
     def _dispatch_keys_ring(
-        self, data: np.ndarray, timer, metrics: Metrics, fused: bool = False
+        self, data: np.ndarray, timer, metrics: Metrics, fused: bool = False,
+        redundancy: int = 1,
     ):
         """Ring counterpart of `_dispatch_keys`: plan, size, exchange.
 
@@ -663,15 +707,26 @@ class SampleSort:
         becomes a per-step capacity choice.  Overflow on this path means
         the exchange ran against a different splitter plan than the one
         that sized its buffers — an invariant violation, raised loudly.
+
+        ``redundancy > 1`` runs the CODED schedule (`_build_coded`): the
+        same plan and caps, plus the replica plane.  The fault hook then
+        fires AFTER the exchange dispatch — replica placement completes
+        with the exchange (see `parallel.coded`'s simulation note), so a
+        loss tripping there leaves the survivors holding everything a
+        local reconstruction needs; the raised `WorkerFailure` carries the
+        `CodedExchangeState` snapshot for the caller's recovery path.
         """
         from dsort_tpu.parallel.exchange import (
             check_ring_overflow,
+            note_coded_plan,
             note_fused_plan,
             note_ring_plan,
             ring_caps,
         )
+        from dsort_tpu.scheduler.fault import WorkerFailure
 
         p = self.num_workers
+        coded = redundancy > 1
         shard_spec = NamedSharding(self.mesh, P(self.axis))
         with timer.phase("partition"):
             shards, counts = pad_to_shards(data, p)
@@ -686,15 +741,26 @@ class SampleSort:
             hist_h = jax.device_get(hist)
         LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
-        note = note_fused_plan if fused else note_ring_plan
-        note(
-            metrics, caps, hist_h, n_local, p, data.dtype.itemsize,
-            self.job.capacity_factor,
-        )
-        if self.fault_hook is not None:
+        if coded:
+            note_coded_plan(
+                metrics, caps, hist_h, n_local, p, data.dtype.itemsize,
+                self.job.capacity_factor, redundancy,
+            )
+        else:
+            note = note_fused_plan if fused else note_ring_plan
+            note(
+                metrics, caps, hist_h, n_local, p, data.dtype.itemsize,
+                self.job.capacity_factor,
+            )
+        if not coded and self.fault_hook is not None:
             self.fault_hook()
         with timer.phase("spmd_sort"):
-            if fused:
+            if coded:
+                codedfn = self._build_coded(n_local, caps, redundancy)
+                merged, out_counts, overflow, reps, rep_lens = codedfn(
+                    xs_sorted, cj, splitters
+                )
+            elif fused:
                 fusedfn = self._build_fused(n_local, caps)
                 merged, out_counts, overflow = fusedfn(
                     xs_sorted, cj, splitters, hist
@@ -702,12 +768,39 @@ class SampleSort:
             else:
                 ringfn = self._build_ring(n_local, caps)
                 merged, out_counts, overflow = ringfn(xs_sorted, cj, splitters)
+        if coded and self.fault_hook is not None:
+            try:
+                self.fault_hook()
+            except WorkerFailure as e:
+                # The loss surfaced with the replica plane already placed:
+                # snapshot what the survivors hold so the caller's recovery
+                # is a local merge, not a re-run (parallel.coded).
+                e.coded_state = self._snapshot_coded(
+                    merged, out_counts, overflow, reps, rep_lens, caps,
+                    redundancy, len(data),
+                )
+                raise
+        with timer.phase("spmd_sort"):
             # One fetch = completion barrier + the invariant scalar (same
             # doctrine as the all_to_all path).
             c, ov = jax.device_get((out_counts, overflow))
         LEDGER.drain_to(metrics)
         check_ring_overflow(ov)
         return merged, out_counts, c
+
+    def _snapshot_coded(
+        self, merged, out_counts, overflow, reps, rep_lens, caps: tuple,
+        redundancy: int, n: int,
+    ):
+        """Host snapshot of one coded exchange (`parallel.coded`'s shared
+        fetch: survivors' trimmed ranges + the replica plane, overflow
+        invariant checked first)."""
+        from dsort_tpu.parallel.coded import snapshot_state
+
+        return snapshot_state(
+            self.num_workers, redundancy, caps, n,
+            merged, out_counts, overflow, reps, rep_lens,
+        )
 
     def _dispatch_kv_ring(
         self, xs, vs, cj, n_local: int, trailing: tuple, slot_bytes: int,
@@ -765,6 +858,7 @@ class SampleSort:
         metrics: Metrics | None = None,
         keep_on_device: bool = False,
         exchange: str | None = None,
+        redundancy: int | None = None,
     ) -> np.ndarray:
         """Sort a host array; returns the globally sorted host array.
 
@@ -797,22 +891,27 @@ class SampleSort:
                     "ride as mapped ordered uints the consumer would "
                     "misread); use sort() for floats"
                 )
-            return self._sort_device_impl(data, metrics, exchange=exchange)
+            return self._sort_device_impl(
+                data, metrics, exchange=exchange, redundancy=redundancy
+            )
         if is_float_key_dtype(data.dtype):
             return sort_float_keys_via_uint(
-                self.sort, data, metrics, exchange=exchange
+                self.sort, data, metrics, exchange=exchange,
+                redundancy=redundancy,
             )
         if len(data) == 0:
             return np.asarray(data).copy()
         # The ranges are views into ONE preallocated output buffer laid out
         # in global order, so the buffer IS the sorted array — no
         # np.concatenate re-copy (VERDICT r4 next #1).
-        buf, _ = self._sort_ranges_impl(data, metrics, exchange=exchange)
+        buf, _ = self._sort_ranges_impl(
+            data, metrics, exchange=exchange, redundancy=redundancy
+        )
         return buf
 
     def sort_ranges(
         self, data: np.ndarray, metrics: Metrics | None = None,
-        exchange: str | None = None,
+        exchange: str | None = None, redundancy: int | None = None,
     ) -> list[np.ndarray]:
         """Like `sort`, but returns the per-device key ranges separately.
 
@@ -823,11 +922,13 @@ class SampleSort:
         handle float keys themselves (`SpmdScheduler` maps them to ordered
         uints *before* any checkpointed phase).
         """
-        return self._sort_ranges_impl(data, metrics, exchange=exchange)[1]
+        return self._sort_ranges_impl(
+            data, metrics, exchange=exchange, redundancy=redundancy
+        )[1]
 
     def _sort_ranges_impl(
         self, data: np.ndarray, metrics: Metrics | None = None,
-        exchange: str | None = None,
+        exchange: str | None = None, redundancy: int | None = None,
     ) -> tuple[np.ndarray, list[np.ndarray]]:
         """Shared core: returns ``(sorted buffer, per-device range views)``.
 
@@ -855,13 +956,15 @@ class SampleSort:
             return data.copy(), [data.copy()]
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
-        merged, _, c = self._dispatch_keys(data, timer, metrics, exchange)
+        merged, _, c = self._dispatch_keys(
+            data, timer, metrics, exchange, redundancy
+        )
         with timer.phase("assemble"):
             return self._assemble_ranges(merged, c, len(data), self.num_workers)
 
     def _dispatch_keys(
         self, data: np.ndarray, timer, metrics: Metrics,
-        exchange: str | None = None,
+        exchange: str | None = None, redundancy: int | None = None,
     ):
         """Upload + run the SPMD program with measured-capacity retries.
 
@@ -871,11 +974,23 @@ class SampleSort:
         counts, and the host copy of those counts the retry loop already
         fetched (the ONE small device->host fetch that is both the
         completion barrier and every retry scalar).
+
+        A resolved ``redundancy > 1`` forces the lax ring schedule: the
+        replica plane rides the ring's ppermute steps (`parallel.coded`) —
+        the padded all_to_all has no per-step seam to ship replicas on, and
+        the fused kernel carries no replica slots yet.
         """
         exch = self._resolve_exchange(exchange)
+        red = self._resolve_redundancy(redundancy)
+        if red > 1 and exch != "ring":
+            log.warning(
+                "redundancy=%d needs the lax ring schedule; overriding "
+                "exchange=%r to 'ring' for this dispatch", red, exch,
+            )
+            exch = "ring"
         if exch in ("ring", "fused"):
             return self._dispatch_keys_ring(
-                data, timer, metrics, fused=exch == "fused"
+                data, timer, metrics, fused=exch == "fused", redundancy=red
             )
         p = self.num_workers
         shard_spec = NamedSharding(self.mesh, P(self.axis))
@@ -922,7 +1037,7 @@ class SampleSort:
 
     def _sort_device_impl(
         self, data: np.ndarray, metrics: Metrics | None,
-        exchange: str | None = None,
+        exchange: str | None = None, redundancy: int | None = None,
     ):
         """`keep_on_device` core: dispatch, then hand out the sharded result.
 
@@ -945,7 +1060,7 @@ class SampleSort:
             )
         else:
             merged, out_counts, c = self._dispatch_keys(
-                data, timer, metrics, exchange
+                data, timer, metrics, exchange, redundancy
             )
             handle = DeviceSortResult(
                 merged,
@@ -1004,6 +1119,14 @@ class SampleSort:
                 exchange=exchange,
             )
         exch = self._resolve_exchange(exchange)
+        if self._resolve_redundancy(None) > 1:
+            # The replica plane is keys-only today: payload replicas would
+            # r-x the exchange's payload traffic for a recovery the k-way
+            # record merge paths don't consume yet (ARCHITECTURE §14 scope).
+            log.warning(
+                "redundancy=%d applies to keys-only jobs; this kv sort "
+                "runs uncoded (re-run recovery)", self.job.redundancy,
+            )
         if exch in ("ring", "fused") and secondary is not None:
             # The ring's tag plane carries (is_pad, position); adding the
             # secondary would need a third merge channel per fold — the
@@ -1286,6 +1409,16 @@ class BatchSampleSort:
         skipped (a device-resident handle is not a persisted artifact).
         """
         metrics = metrics if metrics is not None else Metrics()
+        if self.job.redundancy > 1:
+            # The replica plane rides the single-job ring schedule only:
+            # the batched (dp, w) driver has no coded shard program yet
+            # (ARCHITECTURE §14 scope) — run uncoded rather than silently
+            # pretending the batch is loss-tolerant.
+            log.warning(
+                "redundancy=%d applies to single-job keys-only sorts; "
+                "this batch runs uncoded (re-run recovery)",
+                self.job.redundancy,
+            )
         jobs = [np.asarray(j) for j in jobs]
         if not jobs:
             return []

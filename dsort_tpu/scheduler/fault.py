@@ -144,6 +144,7 @@ class FaultInjector:
         self._killed: set[int] = set()
         self._one_shots: dict[tuple[int, str], int] = {}
         self._hangs: dict[tuple[int, str], float] = {}
+        self._sequence: list[tuple[int, str]] = []
         self.trips = 0
 
     def kill(self, worker: int) -> None:
@@ -158,6 +159,21 @@ class FaultInjector:
         with self._lock:
             self._one_shots[(worker, stage)] = (
                 self._one_shots.get((worker, stage), 0) + times
+            )
+
+    def fail_sequence(self, entries) -> None:
+        """Ordered multi-trip injection: ``entries`` is a list of
+        ``(worker, stage)`` pairs that trip strictly IN ORDER — a `check`
+        matching the current head consumes it and raises; the next entry
+        arms immediately, so one sweep of checks over the mesh (the coded
+        ring hook) can trip several losses in a single attempt, and a later
+        attempt's sweep continues from wherever the sequence stands
+        (re-armed per attempt).  This is how a drill injects a SECOND loss
+        in the same job — e.g. killing both a range's owner and its replica
+        holder to drive the coded plane's over-budget fallback."""
+        with self._lock:
+            self._sequence.extend(
+                (int(w), str(s)) for w, s in entries
             )
 
     def hang_once(self, worker: int, stage: str = "sort", seconds: float = 3600.0) -> None:
@@ -183,6 +199,10 @@ class FaultInjector:
                 left = self._one_shots.get((worker, stage), 0)
                 if left > 0:
                     self._one_shots[(worker, stage)] = left - 1
+                    self.trips += 1
+                    raise WorkerFailure(worker, stage)
+                if self._sequence and self._sequence[0] == (worker, stage):
+                    self._sequence.pop(0)
                     self.trips += 1
                     raise WorkerFailure(worker, stage)
         if hang is not None:

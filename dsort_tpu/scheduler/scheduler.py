@@ -189,6 +189,18 @@ def _size_bucket(n: int) -> int:
     return 1 << max(int(n - 1).bit_length(), 0) if n > 1 else 1
 
 
+def _sort_kwargs(exchange, redundancy) -> dict:
+    """Per-call knob kwargs, omitted when unset: `None` means "JobConfig
+    decides" and needs no plumbing — wrappers around SampleSort.sort /
+    sort_ranges (fault drills monkeypatch them) keep their original
+    signatures working.  ONE builder so a new knob can never be threaded
+    through one recovery path and dropped on another."""
+    kw = {} if exchange is None else {"exchange": exchange}
+    if redundancy is not None:
+        kw["redundancy"] = redundancy
+    return kw
+
+
 def _make_flight_recorder(job: JobConfig, state_fn):
     """A `FlightRecorder` when the job configures one, else None.
 
@@ -720,7 +732,7 @@ class SpmdScheduler:
     def _shuffle_with_range_checkpoint(
         self, work: np.ndarray, ckpt, ss, metrics: Metrics, live: list[int],
         cancelled: threading.Event | None = None,
-        exchange: str | None = None,
+        exchange: str | None = None, redundancy: int | None = None,
     ) -> np.ndarray:
         """Phase B with per-range persistence (SURVEY.md §5.4, upgraded).
 
@@ -745,11 +757,10 @@ class SpmdScheduler:
                     [ckpt.load_range(i) for i in sorted(done)]
                 )
             return self._resume_missing_ranges(
-                work, ckpt, ss, done, metrics, cancelled, exchange
+                work, ckpt, ss, done, metrics, cancelled, exchange, redundancy
             )
-        # None -> no kwarg: monkeypatched sort_ranges wrappers keep working.
         outs = ss.sort_ranges(
-            work, metrics, **({} if exchange is None else {"exchange": exchange})
+            work, metrics, **_sort_kwargs(exchange, redundancy)
         )
         self._check_cancelled(cancelled)
         # Fresh sort: the range views share ONE backing buffer already laid
@@ -790,7 +801,7 @@ class SpmdScheduler:
     def _resume_missing_ranges(
         self, work: np.ndarray, ckpt, ss, done: list[int], metrics: Metrics,
         cancelled: threading.Event | None = None,
-        exchange: str | None = None,
+        exchange: str | None = None, redundancy: int | None = None,
     ) -> np.ndarray:
         """Re-sort only the key intervals whose ranges were lost.
 
@@ -831,7 +842,7 @@ class SpmdScheduler:
             len(subset), len(work),
         )
         sorted_subset = ss.sort(
-            subset, metrics, **({} if exchange is None else {"exchange": exchange})
+            subset, metrics, **_sort_kwargs(exchange, redundancy)
         )
         present_concat = (
             np.concatenate(present) if present else subset[:0]
@@ -857,6 +868,54 @@ class SpmdScheduler:
             man.get("total", len(work)),
             fingerprint=man.get("fingerprint"),
             n_ranges=1,
+        )
+        return out
+
+    def _try_coded_recovery(
+        self, e: WorkerFailure, live: list[int], metrics: Metrics, data,
+    ):
+        """Coded reconstruction of a failed attempt (`parallel.coded`).
+
+        Returns the full sorted output when the attempt's exchange carried
+        a replica plane (``e.coded_state``) that covers the losses —
+        recovery is then a local merge of a survivor's replica slots, with
+        the journal recording ``coded_recover`` (the flight recorder dumps
+        a ``coded_reconstruct`` bundle off it) and the
+        ``coded_recoveries``/``coded_recovered_keys`` counters.  Returns
+        None — journaling ``coded_budget_exceeded`` — when the losses
+        exceed the redundancy budget, and the caller's loop degrades to
+        today's re-run path.
+        """
+        state = getattr(e, "coded_state", None)
+        if state is None:
+            return None
+        if state.n != len(data):
+            # The snapshot covers only part of the job — a coded loss
+            # inside a checkpoint-resume's SUBSET re-sort.  Completing
+            # from it would return the subset as the whole job's output,
+            # silently dropping every restored range; degrade to the
+            # re-run loop, whose next attempt resumes correctly.
+            log.warning(
+                "coded snapshot covers %d of %d keys (a resume-subset "
+                "dispatch); taking the re-run path", state.n, len(data),
+            )
+            return None
+        from dsort_tpu.parallel.coded import dead_positions, journal_recovery
+
+        positions = dead_positions(e, live)
+        rec = journal_recovery(metrics, state, positions)
+        if rec is None:
+            log.warning(
+                "coded recovery over budget (positions %s dead at "
+                "redundancy=%d); degrading to the re-run path",
+                sorted(positions), state.redundancy,
+            )
+            return None
+        out, info = rec
+        log.warning(
+            "coded recovery: %d key(s) of %d dead range(s) reconstructed "
+            "from replica slots — zero keys re-sorted, zero re-dispatch",
+            info["recovered_keys"], len(positions),
         )
         return out
 
@@ -934,6 +993,7 @@ class SpmdScheduler:
         job_id: str | None = None,
         keep_on_device: bool = False,
         exchange: str | None = None,
+        redundancy: int | None = None,
     ) -> np.ndarray:
         """Whole-mesh sort; with ``keep_on_device=True`` the result stays
         sharded on the mesh as a `parallel.DeviceSortResult` under the SAME
@@ -965,7 +1025,8 @@ class SpmdScheduler:
             # Map floats before the checkpointed local-sort phase too — a
             # checkpointed run of raw floats would already have dropped NaNs.
             return sort_float_keys_via_uint(
-                self.sort, data, metrics, job_id, exchange=exchange
+                self.sort, data, metrics, job_id, exchange=exchange,
+                redundancy=redundancy,
             )
         metrics = metrics if metrics is not None else Metrics()
         if self.flight is not None:
@@ -1066,8 +1127,21 @@ class SpmdScheduler:
                     current = list(live)
 
                     def ring_hook():
+                        # Sweep EVERY live worker and aggregate: a coded
+                        # exchange must learn about all of an attempt's
+                        # losses at once (losing both a range's owner and
+                        # its replica holder is the over-budget case), so
+                        # the raised failure carries the full list.
+                        failed = []
                         for i in current:
-                            self.injector.check(i, "ring")
+                            try:
+                                self.injector.check(i, "ring")
+                            except WorkerFailure as f:
+                                failed.append(f.worker)
+                        if failed:
+                            err = WorkerFailure(failed[0], "ring")
+                            err.workers = failed
+                            raise err
 
                     ss.fault_hook = ring_hook
                 else:
@@ -1076,14 +1150,14 @@ class SpmdScheduler:
                 # means "JobConfig.exchange decides" and needs no plumbing —
                 # wrappers around SampleSort.sort (fault drills monkeypatch
                 # it) keep their pre-exchange signature working.
-                kw = {} if exchange is None else {"exchange": exchange}
+                kw = _sort_kwargs(exchange, redundancy)
                 if keep_on_device:
                     return ss.sort(work, metrics, keep_on_device=True, **kw)
                 if ckpt is None:
                     return ss.sort(work, metrics, **kw)
                 return self._shuffle_with_range_checkpoint(
                     work, ckpt, ss, metrics, live, cancelled,
-                    exchange=exchange,
+                    exchange=exchange, redundancy=redundancy,
                 )
 
             try:
@@ -1102,7 +1176,7 @@ class SpmdScheduler:
                     # live, so the handle heals instead of erroring.
                     out._rerun = lambda: self.sort(
                         data, metrics=metrics, keep_on_device=True,
-                        exchange=exchange,
+                        exchange=exchange, redundancy=redundancy,
                     )
                     self._register_handle(out)
                 metrics.event(
@@ -1111,16 +1185,35 @@ class SpmdScheduler:
                 )
                 return out
             except WorkerFailure as e:
+                # A multi-loss sweep (the coded ring hook) aggregates every
+                # tripped worker on `e.workers`; a plain failure names one.
+                dead_workers = list(getattr(e, "workers", None) or [e.worker])
                 log.warning(
-                    "device %d lost; re-forming mesh over %d survivors",
-                    e.worker, len(live) - 1,
+                    "device(s) %s lost; re-forming mesh over %d survivors",
+                    dead_workers, len(live) - len(dead_workers),
                 )
-                self.table.mark_dead(e.worker)
-                metrics.event("worker_dead", worker=e.worker, stage=e.stage)
+                for w in dead_workers:
+                    self.table.mark_dead(w)
+                    metrics.event("worker_dead", worker=w, stage=e.stage)
                 metrics.bump("mesh_reforms")
-                metrics.event("mesh_reform", survivors=len(live) - 1)
+                metrics.event(
+                    "mesh_reform", survivors=len(live) - len(dead_workers)
+                )
                 self._invalidate_handles("mesh_reform", metrics)
-                self._notify_reform([e.worker])
+                self._notify_reform(dead_workers)
+                # Coded redundancy (ARCHITECTURE §14): when the failed
+                # attempt's exchange shipped replicas, the survivors already
+                # hold the dead ranges — recover by a LOCAL merge on the
+                # re-formed mesh's watch (zero keys re-sorted, zero
+                # re-dispatch) instead of looping into the re-run.
+                if not keep_on_device:
+                    out = self._try_coded_recovery(e, live, metrics, data)
+                    if out is not None:
+                        metrics.event(
+                            "job_done", n_keys=len(data),
+                            counters=dict(metrics.counters),
+                        )
+                        return out
                 time.sleep(self.job.settle_delay_s)
             except ProgramWaitTimeout as e:
                 # The in-flight program wait lapsed — the hang the reference

@@ -73,6 +73,11 @@ class JobTicket:
         self.metrics = metrics
         self.n_keys = len(data)
         self.readmits = 0
+        # Coded redundancy (ARCHITECTURE §14): a coded job evicted by a
+        # device loss parks its replica snapshot here; the re-dispatch then
+        # completes from replica slots instead of re-running the sort.
+        self.coded_state = None
+        self.coded_dead: list = []
         self.admitted_mono = time.monotonic()
         self.queued_mono = self.admitted_mono  # reset on re-admission
         self._done = threading.Event()
@@ -183,12 +188,20 @@ class SortService:
             # The service recorder dumps ONLY evictions: the schedulers'
             # own recorders already cover mesh re-forms / capacity retries,
             # and a second dump of the same event would double-count.
+            # Runner mode owns no scheduler recorder, so the service one
+            # also dumps the coded-reconstruct bundle; with a scheduler the
+            # coded_recover fires inside ITS recovery (its recorder dumps)
+            # and the service filter stays eviction-only — one bundle per
+            # recovery, never two.
+            svc_events = {"job_evicted"}
+            if runner is not None:
+                svc_events.add("coded_recover")
             self.flight = FlightRecorder(
                 self.job.flight_recorder_dir,
                 ring_size=self.job.flight_ring_size,
                 state_fn=self._flight_state,
                 config=self.job,
-                events=frozenset({"job_evicted"}),
+                events=frozenset(svc_events),
             )
         self._pool = ThreadPoolExecutor(
             max_workers=max(len(self._slices), 1),
@@ -353,13 +366,22 @@ class SortService:
 
     def _execute(self, ticket: JobTicket, alloc: tuple, big: bool) -> None:
         try:
-            if self._runner is not None:
+            out = None
+            if ticket.coded_state is not None:
+                # An evicted CODED job completes from the replica snapshot
+                # its failed attempt left behind — a local merge, zero
+                # re-run; an over-budget snapshot returns None and degrades
+                # to the ordinary dispatch below.
+                state, dead = ticket.coded_state, list(ticket.coded_dead)
+                ticket.coded_state, ticket.coded_dead = None, []
+                out = self._complete_coded(ticket, state, dead)
+            if out is None and self._runner is not None:
                 out = self._runner(
                     ticket.data, ticket.metrics, job_id=ticket.ckpt_job_id
                 )
-            elif big:
+            elif out is None and big:
                 out = self._sort_big(ticket)
-            else:
+            elif out is None:
                 out = self._sort_small(ticket, alloc[0])
         except BaseException as e:
             if not big and self._should_readmit(ticket, e):
@@ -444,6 +466,34 @@ class SortService:
 
     # -- fault handling -----------------------------------------------------
 
+    def _complete_coded(self, ticket: JobTicket, state, dead):
+        """Finish one re-admitted coded job from its replica snapshot.
+
+        Returns the sorted output (journaling ``coded_recover`` — in
+        runner mode the service flight recorder dumps the
+        ``coded_reconstruct`` bundle off it — and closing the job with
+        ``job_done``), or None after journaling ``coded_budget_exceeded``
+        so the caller falls back to the re-run dispatch."""
+        from dsort_tpu.parallel.coded import journal_recovery
+
+        m = ticket.metrics
+        rec = journal_recovery(m, state, dead, tenant=ticket.tenant)
+        if rec is None:
+            log.warning(
+                "coded completion over budget for tenant %s (positions %s "
+                "at redundancy=%d); re-running",
+                ticket.tenant, sorted(dead), state.redundancy,
+            )
+            return None
+        out, info = rec
+        m.event("job_done", n_keys=len(out), counters=dict(m.counters))
+        log.warning(
+            "job for tenant %s completed from replica slots after "
+            "eviction: %d key(s) reconstructed, zero re-run",
+            ticket.tenant, info["recovered_keys"],
+        )
+        return out
+
     def _should_readmit(self, ticket: JobTicket, e: BaseException) -> bool:
         faulty = isinstance(e, (WorkerFailure, ProgramWaitTimeout)) or (
             classify_runtime_error(e) is not None
@@ -461,6 +511,13 @@ class SortService:
         """
         m = ticket.metrics
         ticket.readmits += 1
+        # A coded attempt's failure carries the replica snapshot: park it
+        # on the ticket so the re-dispatch completes from replicas
+        # (`_complete_coded`) instead of re-running.
+        state = getattr(e, "coded_state", None)
+        if state is not None:
+            ticket.coded_state = state
+            ticket.coded_dead = list(getattr(e, "workers", None) or [e.worker])
         reason = (str(e).splitlines() or [repr(e)])[0][:120]
         m.event(
             "job_evicted", tenant=ticket.tenant, reason=reason,

@@ -137,6 +137,18 @@ EVENT_TYPES: dict[str, str] = {
                       "controller dumps a flight bundle and health routing "
                       "penalizes it for big jobs (agent, score, "
                       "dominant_phase)",
+    # Coded redundancy plane (parallel.coded, ARCHITECTURE §14):
+    "coded_replica_ship": "one coded exchange planned its replica plane — "
+                          "every bucket re-shipped to its destination's "
+                          "r-1 ring successors (redundancy, slots, bytes)",
+    "coded_recover": "a dead device's range was reconstructed by a LOCAL "
+                     "merge of a survivor's replica slots — zero keys "
+                     "re-sorted, zero re-dispatch (dead, holders, "
+                     "recovered_keys, replica_bytes, redundancy, wall_s)",
+    "coded_budget_exceeded": "losses exceeded the replica budget (a dead "
+                             "range's every holder dead too); recovery "
+                             "degraded cleanly to the re-run path (dead, "
+                             "redundancy)",
     # Out-of-core wave pipeline (models.wave_sort, ARCHITECTURE §10):
     "wave_start": "one input wave entered the mesh pipeline "
                   "(wave, n_keys)",
@@ -218,6 +230,12 @@ COUNTERS: dict[str, str] = {
                        "journaled",
     "agent_degradations": "agent health verdicts that flipped degraded "
                           "(each dumps one flight bundle)",
+    "coded_recoveries": "device losses recovered by a local replica-slot "
+                        "merge instead of a re-run (parallel.coded)",
+    "coded_replica_bytes": "wire bytes the coded replica plane shipped "
+                           "(also charged to exchange_bytes_on_wire)",
+    "coded_recovered_keys": "keys reconstructed from replica slots by "
+                            "coded recoveries (merged, never re-sorted)",
     "waves_sorted": "input waves run through the mesh exchange pipeline",
     "wave_runs_resorted": "(wave, run) store entries re-sorted by the "
                           "run-granular resume/repair path",

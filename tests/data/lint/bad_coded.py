@@ -1,0 +1,43 @@
+"""Fixture: coded-recovery discipline violations (DS201/DS202 + DS301).
+
+Models the coded redundancy plane's two riskiest shapes: a replica-state
+table whose slots must stay lock-guarded with no blocking work under the
+lock (the reconstruction is a k-way MERGE of host runs — holding the
+table lock across it would serialize every concurrently-failing job's
+recovery behind one slow merge), and an exchange shard function that must
+never journal its recovery from inside a traced program (the recovery
+wall time would become a trace-time constant and the event would fire
+once per compile, not per recovery).
+"""
+
+import threading
+import time
+
+import jax
+
+
+class ReplicaTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+        self._recoveries = []
+
+    def park(self, dead, state):
+        with self._lock:
+            self._slots[dead] = state
+
+    def park_racy(self, dead, state):
+        self._slots[dead] = state  # DS201: guarded attribute, no lock held
+
+    def reconstruct_under_lock(self, merge, dead):
+        with self._lock:
+            time.sleep(0.01)  # DS202: the settle delay, lock held
+            return merge.wait()  # DS202: blocking k-way merge under the lock
+
+
+@jax.jit
+def recover_inside_trace(x, metrics):
+    metrics.event("coded_recover", dead=[3], recovered_keys=7)  # DS301
+    t0 = time.perf_counter()  # DS301: recovery wall clock baked at trace
+    print("reconstructed at", t0)  # DS301
+    return x + 1

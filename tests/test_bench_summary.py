@@ -161,8 +161,11 @@ def test_schema_header_shape():
     hdr = bench._schema_header()
     assert hdr["bench_schema"] == bench.BENCH_SCHEMA_VERSION
     assert hdr["required"] == {"metric": "str", "value": "num", "unit": "str"}
-    # The header itself is one JSON line well under any tail bound.
-    assert len(json.dumps(hdr)) < 1800
+    # The header is the artifact's FIRST line (never the driver-parsed
+    # tail — that bound binds `_compact_summary` above); this bound only
+    # keeps it one sanely-sized JSON line as the field vocabulary grows
+    # with each bench family (~9 typed fields per PR).
+    assert len(json.dumps(hdr)) < 4000
 
 
 def test_check_artifact_accepts_valid_lines(tmp_path):

@@ -115,6 +115,18 @@ def test_health_fixture():
     assert run_fixture("good_health.py") == []
 
 
+def test_coded_fixture():
+    """ISSUE 15: the coded redundancy plane's discipline contract — the
+    replica-state table stays lock-guarded with the k-way reconstruction
+    merge outside the lock, and no recovery event or wall clock is
+    emitted from inside a traced function (the recovery cost would become
+    a trace-time constant)."""
+    diags = run_fixture("bad_coded.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS201": 1, "DS202": 2, "DS301": 3}
+    assert run_fixture("good_coded.py") == []
+
+
 def test_durability_checker_fixture():
     """ISSUE 13: the PR 12 review-fix classes stay pinned — a raw write to
     a persisted-state path, a rename with no fsync, and persist IO under a
