@@ -139,6 +139,14 @@ BENCH_SCHEMA_FIELD_TYPES = {
     "recovered_keys": "num",
     "baseline_keys_per_sec": "num",
     "rerun_keys_per_sec": "num",
+    # Closed-loop planner A/B rows (`dsort bench --autotune-ab`, ISSUE 16):
+    "chosen_exchange": "str",
+    "expected_exchange": "str",
+    "best_arm": "str",
+    "best_keys_per_sec": "num",
+    "alltoall_keys_per_sec": "num",
+    "autotune_vs_best": "num",
+    "plan_decisions": "num",
 }
 
 _SCHEMA_TYPE_CHECKS = {
@@ -1369,6 +1377,46 @@ print(json.dumps({
     except Exception as e:  # the ladder must never sink the artifact
         _emit(
             "coded_redundancy_failure_zipf_8dev_cpu_mesh", 0.0, "keys/sec",
+            baseline=False,
+            error=(str(e).splitlines() or [repr(e)])[0][:200],
+        )
+
+    # Closed-loop planner rows (ISSUE 16 / ROADMAP item 4): the same zipf
+    # and uniform workloads with the exchange schedule hand-set to
+    # alltoall, hand-set to ring, and planner-chosen (autotune on, knob
+    # unset) — the planner's measured skew probe must pick ring on zipf /
+    # alltoall on uniform, ship bit-identical keys, and land within 0.95x
+    # of the best hand-set arm at this 1M ladder size (probe overhead must
+    # not eat the win).  The harness is `dsort bench --autotune-ab` — ONE
+    # copy of the contract, shared with `make autotune-smoke`.
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "dsort_tpu.cli", "bench",
+                "--autotune-ab", "--n", str(1 << 20), "--reps", "3",
+            ],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        rows = []
+        for ln in r.stdout.strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        for row in rows:
+            row["metric"] += "_8dev_cpu_mesh"
+            _emit_line(row)
+        if not rows:
+            raise RuntimeError(
+                f"autotune A/B emitted no rows (rc {r.returncode}): "
+                + (r.stderr.strip().splitlines() or ["no stderr"])[-1][:160]
+            )
+    except Exception as e:  # the ladder must never sink the artifact
+        _emit(
+            "autotune_ab_zipf_int64_1M_8dev_cpu_mesh", 0.0, "keys/sec",
             baseline=False,
             error=(str(e).splitlines() or [repr(e)])[0][:200],
         )

@@ -67,10 +67,20 @@ def _load_config(args) -> SortConfig:
     Overrides use ``dataclasses.replace`` on the loaded config — NOT a
     rebuild through a key mapping — so a single CLI flag can never silently
     drop conf-file settings it doesn't know about (code-review r3).
+
+    Autotune precedence (obs.plan, ARCHITECTURE §15): ``--no-autotune``
+    wins, then an explicit conf ``AUTOTUNE=``, else ON — the CLI defaults
+    the closed loop on (the library's `JobConfig` default stays off).  A
+    knob flag actually given (``--exchange``, ``--redundancy``,
+    ``--prewarm all``) joins ``JobConfig.explicit`` so the planner never
+    overrides it — it journals a ``plan_override`` instead.
     """
     import dataclasses
 
-    cfg = SortConfig.from_conf_file(args.conf) if args.conf else SortConfig()
+    from dsort_tpu.config import load_conf_file
+
+    conf_map = load_conf_file(args.conf) if args.conf else {}
+    cfg = SortConfig.from_mapping(conf_map) if args.conf else SortConfig()
     job_over: dict = {}
     mesh_over: dict = {}
     if getattr(args, "workers", None):
@@ -91,6 +101,19 @@ def _load_config(args) -> SortConfig:
         job_over["tenant"] = args.tenant
     if getattr(args, "flight_dir", None):
         job_over["flight_recorder_dir"] = args.flight_dir
+    if getattr(args, "no_autotune", False):
+        job_over["autotune"] = False
+    elif "AUTOTUNE" not in conf_map:
+        job_over["autotune"] = True
+    explicit = set(cfg.job.explicit)
+    if getattr(args, "exchange", None):
+        explicit.add("exchange")
+    if getattr(args, "redundancy", None):
+        explicit.add("redundancy")
+    if getattr(args, "prewarm", None) == "all":
+        explicit.add("prewarm")
+    if explicit != set(cfg.job.explicit):
+        job_over["explicit"] = tuple(sorted(explicit))
     if job_over:
         cfg = dataclasses.replace(cfg, job=dataclasses.replace(cfg.job, **job_over))
     if mesh_over:
@@ -500,6 +523,11 @@ def _make_serve_service(args, cfg, journal, telemetry):
         serve_over["tenant_weights"] = parse_weights(args.weights)
     if getattr(args, "slo_shed_ms", None):
         serve_over["slo_shed_ms"] = args.slo_shed_ms
+    # ``--prewarm`` (no value / "auto") predicts the set from the planner's
+    # admission history; ``--prewarm all`` keeps the old exhaustive ladder
+    # (obs.plan's prewarm policy, ARCHITECTURE §15).
+    if getattr(args, "prewarm", None) == "all":
+        serve_over["prewarm_policy"] = "all"
     serve_cfg = dataclasses.replace(cfg.serve, **serve_over)
     kwargs = dict(
         job=cfg.job, serve=serve_cfg, telemetry=telemetry, journal=journal,
@@ -513,7 +541,7 @@ def _make_serve_service(args, cfg, journal, telemetry):
         service = SortService(devices=devs[:n], **kwargs)
     else:
         service = SortService(runner=_make_sorter(cfg, args.mode), **kwargs)
-    if getattr(args, "prewarm", False) or serve_cfg.prewarm:
+    if getattr(args, "prewarm", None) or serve_cfg.prewarm:
         n = service.prewarm()
         log.info("compiled-variant cache prewarmed: %d rung(s)", n)
     return service
@@ -801,6 +829,14 @@ def cmd_fleet(args) -> int:
         telemetry=telemetry,
         health_telemetry=fleet_cfg.telemetry,
         flight_dir=cfg.job.flight_recorder_dir,
+        # Closed-loop redundancy (obs.plan policy 3): with autotune on and
+        # no explicit --redundancy/conf REDUNDANCY, each dispatch stamps a
+        # planned r from the rolling health verdicts; an explicit value is
+        # forwarded as-is and journals a plan_override per dispatch.
+        autotune=cfg.job.autotune,
+        redundancy=(
+            cfg.job.redundancy if cfg.job.is_explicit("redundancy") else None
+        ),
     )
     if controller.stats()["agents"] == 0:
         log.warning(
@@ -1342,6 +1378,135 @@ def _bench_coded_ab(args, cfg: SortConfig) -> int:
         }), flush=True)
     finally:
         _write_journal(journal, args)
+    return 0 if ok_all else 1
+
+
+def _bench_autotune_ab(args, cfg: SortConfig) -> int:
+    """`dsort bench --autotune-ab`: does the planner pay for itself?
+
+    The `make autotune-smoke` target (tier-1-gated) and THE acceptance
+    harness for the planner plane (ARCHITECTURE §15): a zipf-skewed int64
+    workload and a uniform int32 workload, each sorted three ways on the
+    local mesh — exchange hand-set to alltoall, hand-set to ring, and a
+    third arm with ``autotune=True`` and the exchange knob genuinely
+    unset, so the planner's measured skew probe picks the schedule per
+    dispatch and journals a ``plan_decision``.  Gates (ok -> exit 0):
+
+    - every arm's output bit-identical (the planner may only change HOW
+      keys move, never WHAT comes back);
+    - the planner picks ring on the zipf workload and alltoall on the
+      uniform one (the measured ``max_mean_ratio`` vs
+      ``SKEW_RING_THRESHOLD`` contract — the zipf head lands ~P x the
+      mean bucket, uniform sits at ~1.0);
+    - the autotune arm lands within 0.95x of the BEST hand-set arm
+      (probe overhead must not eat the win).  Below 1M keys the
+      throughput gate relaxes to the structural checks only, the same
+      doctrine as ``--analyze-smoke``: at smoke sizes the fixed per-sort
+      dispatch cost drowns the schedule delta and the probe share, so
+      tiny runs check the plane end-to-end, the 1M ladder row checks the
+      number.
+
+    One JSON row per workload with both hand-set throughputs, the
+    autotune throughput, the chosen schedule, and the journaled
+    plan_decision count.
+    """
+    import dataclasses
+
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.ingest import gen_uniform, gen_zipf
+    from dsort_tpu.parallel.mesh import local_device_mesh
+    from dsort_tpu.parallel.sample_sort import SampleSort
+    from dsort_tpu.utils.events import EventLog
+
+    mesh = local_device_mesh(cfg.mesh.num_workers)
+    if mesh.shape["w"] < 2:
+        raise SystemExit(
+            "--autotune-ab needs a multi-worker mesh (every exchange "
+            "schedule is the same program on one worker); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 without "
+            "NUM_WORKERS=1"
+        )
+    journal = _open_journal(args) or EventLog()
+    cases = [
+        (
+            f"zipf_int64_{args.n}",
+            gen_zipf(args.n, a=1.3, seed=4),
+            JobConfig(key_dtype=np.int64, local_kernel=cfg.job.local_kernel),
+            # No TPU on the cpu mesh: the skewed pick is the lax ring
+            # (fused is the TPU-gated upgrade of the same measured plan).
+            "ring",
+        ),
+        (
+            f"uniform_int32_{args.n}",
+            gen_uniform(args.n, seed=0),
+            JobConfig(local_kernel=cfg.job.local_kernel),
+            "alltoall",
+        ),
+    ]
+    ok_all = True
+    try:
+        for label, keys, job, expected in cases:
+            ss_hand = SampleSort(mesh, job)
+            arms = {}
+            for exch in ("alltoall", "ring"):
+                ss_hand.sort(keys, exchange=exch)  # warm/compile
+                times = []
+                for _ in range(args.reps):
+                    t0 = time.perf_counter()
+                    out = ss_hand.sort(keys, exchange=exch)
+                    times.append(time.perf_counter() - t0)
+                arms[exch] = {"dt": float(min(times)), "out": out}
+            # The autotune arm: exchange genuinely unset — the planner's
+            # per-dispatch skew probe decides, and every timed rep
+            # journals its plan_decision with the measured inputs.
+            ss_auto = SampleSort(mesh, dataclasses.replace(job, autotune=True))
+            ss_auto.sort(keys)  # warm/compile (probe runs, unjournaled)
+            start = len(journal)
+            m = Metrics(journal=journal)
+            times = []
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                auto_out = ss_auto.sort(keys, metrics=m)
+                times.append(time.perf_counter() - t0)
+            auto_dt = float(min(times))
+            plans = [
+                e for e in journal.events()[start:]
+                if e.type == "plan_decision"
+                and e.fields.get("policy") == "exchange"
+            ]
+            chosen = plans[-1].fields.get("chosen") if plans else None
+            identical = bool(
+                np.array_equal(auto_out, arms["alltoall"]["out"])
+            ) and bool(np.array_equal(auto_out, arms["ring"]["out"]))
+            best_arm = min(arms, key=lambda a: arms[a]["dt"])
+            best_dt = arms[best_arm]["dt"]
+            vs_best = best_dt / auto_dt if auto_dt > 0 else 0.0
+            # The 0.95x floor binds at ladder size (1M+); smoke sizes are
+            # structural-only (see the docstring).
+            fast_enough = vs_best >= 0.95 or args.n < (1 << 20)
+            ok = (
+                identical and chosen == expected and len(plans) == args.reps
+                and fast_enough
+            )
+            ok_all = ok_all and ok
+            n = len(keys)
+            print(json.dumps({
+                "metric": f"autotune_ab_{label}",
+                "value": round(n / auto_dt, 1),
+                "unit": "keys/sec",
+                "chosen_exchange": chosen,
+                "expected_exchange": expected,
+                "best_arm": best_arm,
+                "best_keys_per_sec": round(n / best_dt, 1),
+                "alltoall_keys_per_sec": round(n / arms["alltoall"]["dt"], 1),
+                "ring_keys_per_sec": round(n / arms["ring"]["dt"], 1),
+                "autotune_vs_best": round(vs_best, 3),
+                "plan_decisions": len(plans),
+                "bit_identical": identical,
+            }), flush=True)
+    finally:
+        if getattr(args, "journal", None):
+            journal.flush_jsonl(args.journal)
     return 0 if ok_all else 1
 
 
@@ -1917,6 +2082,19 @@ def cmd_bench(args) -> int:
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if getattr(args, "autotune_ab", False):
+        if args.suite or getattr(args, "device_resident", False) or getattr(
+            args, "exchange_ab", False
+        ) or getattr(args, "serve_mixed", False) or getattr(
+            args, "analyze_smoke", False
+        ) or getattr(args, "external_wave", False) or getattr(
+            args, "fleet_mixed", False
+        ) or getattr(args, "coded_ab", False):
+            raise SystemExit(
+                "--autotune-ab is its own benchmark: run it as a separate "
+                "invocation"
+            )
+        return _bench_autotune_ab(args, _load_config(args))
     if getattr(args, "coded_ab", False):
         if args.suite or getattr(args, "device_resident", False) or getattr(
             args, "exchange_ab", False
@@ -2686,6 +2864,13 @@ def main(argv=None) -> int:
                        help="fault flight recorder directory: any recovery "
                             "path dumps a postmortem bundle here "
                             "(ring + config + mesh state + counters)")
+        p.add_argument("--no-autotune", action="store_true",
+                       help="disable the closed-loop planner (obs.plan): no "
+                            "measured-signal knob filling, no plan_decision "
+                            "events — every knob rides its flag/conf/default "
+                            "value exactly (conf AUTOTUNE=0; the planner is "
+                            "otherwise ON for CLI runs, and explicit flags "
+                            "always win over it either way)")
         p.add_argument("-o", "--output")
 
     p = sub.add_parser("run", help="sort one file")
@@ -2713,10 +2898,13 @@ def main(argv=None) -> int:
                    help="REPL jobs in flight at once (default 1 = await "
                         "each job, the reference's blocking semantics; >1 "
                         "= async submit with concurrent mesh-slice packing)")
-    p.add_argument("--prewarm", action="store_true",
-                   help="compile the capacity ladder's fused rungs at "
-                        "startup (the compiled-variant cache serves the "
-                        "first job of every size warm)")
+    p.add_argument("--prewarm", nargs="?", const="auto",
+                   choices=("auto", "all"),
+                   help="compile fused rungs at startup: 'auto' (the "
+                        "default value) compiles the planner's predicted "
+                        "rung x dtype set from recent admissions — full "
+                        "ladder on a cold start; 'all' keeps the old "
+                        "exhaustive ladder (conf SERVE_PREWARM=1|all)")
     p.add_argument("--slice-devices", type=int,
                    help="devices per small-job mesh sub-slice (default 1; "
                         "concurrent small jobs pack onto disjoint slices)")
@@ -2753,10 +2941,12 @@ def main(argv=None) -> int:
     p.add_argument("--metrics-port", type=int,
                    help="expose this mesh's live telemetry endpoint "
                         "(render the whole fleet with `dsort top URL...`)")
-    p.add_argument("--prewarm", action="store_true",
-                   help="compile the capacity ladder's fused rungs at "
-                        "startup (advertised to the controller for "
-                        "locality routing)")
+    p.add_argument("--prewarm", nargs="?", const="auto",
+                   choices=("auto", "all"),
+                   help="compile fused rungs at startup, advertised to the "
+                        "controller for locality routing: 'auto' = the "
+                        "planner's predicted set, 'all' = the exhaustive "
+                        "ladder")
     p.add_argument("--slice-devices", type=int,
                    help="devices per small-job mesh sub-slice")
     p.add_argument("--queue-limit", type=int,
@@ -2853,6 +3043,13 @@ def main(argv=None) -> int:
                         "injected device loss (bit-identical gate); JSON "
                         "rows with throughput_under_failure_ratio and the "
                         "healthy-path replica overhead")
+    p.add_argument("--autotune-ab", action="store_true",
+                   help="closed-loop planner A/B: zipf + uniform workloads "
+                        "with exchange hand-set to alltoall, hand-set to "
+                        "ring, and planner-chosen (autotune on, knob "
+                        "unset); gates bit-identical outputs, the measured-"
+                        "skew pick (ring on zipf, alltoall on uniform) and "
+                        "autotune >= 0.95x the best hand-set arm at 1M+")
     p.add_argument("--external-wave", action="store_true",
                    help="out-of-core wave-pipeline benchmark: sort a "
                         "dataset 8x the per-wave device budget through the "

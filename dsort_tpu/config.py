@@ -156,6 +156,23 @@ class JobConfig:
     # (obs.flight.FlightRecorder).
     flight_recorder_dir: str | None = None
     flight_ring_size: int = 256     # events retained in the recorder ring
+    # Closed-loop planner plane (obs.plan, ARCHITECTURE §15).  When on, the
+    # planner fills any knob the user left genuinely unset from measured
+    # signals (journaled as plan_decision events); explicit flag/conf
+    # values always win (journaled as plan_override).  Library default is
+    # OFF (a bare JobConfig() behaves exactly as before); the CLI turns it
+    # on unless --no-autotune / conf AUTOTUNE=0.
+    autotune: bool = False
+    # The tri-state's "explicit" bit: knob names the user actually set
+    # (CLI flag given / conf key present), as opposed to riding the
+    # dataclass default.  Filled by the conf/CLI loaders; the planner only
+    # decides knobs NOT listed here.
+    explicit: tuple = ()
+
+    def is_explicit(self, knob: str) -> bool:
+        """True when the user explicitly set ``knob`` (flag or conf key) —
+        the planner must not override it."""
+        return knob in self.explicit
 
     def __post_init__(self) -> None:
         import jax
@@ -215,6 +232,14 @@ class JobConfig:
             raise ConfigError(
                 f"flight_ring_size must be >= 1, got {self.flight_ring_size}"
             )
+        if not isinstance(self.explicit, tuple):
+            # Frozen dataclass: normalize lists/sets in place.
+            object.__setattr__(self, "explicit", tuple(self.explicit))
+        for knob in self.explicit:
+            if not isinstance(knob, str) or not knob:
+                raise ConfigError(
+                    f"explicit must name knobs as strings, got {knob!r}"
+                )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -243,7 +268,13 @@ class ServeConfig:
         default_factory=dict
     )
     variant_cache_entries: int = 64  # LRU bound on cached compiled variants
-    prewarm: bool = False            # compile the ladder's rungs at startup
+    prewarm: bool = False            # compile warm rungs at startup
+    # Which rungs the startup prewarm compiles: "auto" = the planner's
+    # predicted set from the admission stream's recent rung x dtype mix
+    # (obs.plan's prewarm policy; falls back to the full ladder on a cold
+    # start with no history), "all" = the old exhaustive ladder
+    # (--prewarm all / conf SERVE_PREWARM=all).
+    prewarm_policy: str = "auto"
     prewarm_min_keys: int = 1 << 14
     prewarm_max_keys: int = 1 << 16
     # SLO-driven admission shedding (--slo-shed-ms): reject with the typed
@@ -284,6 +315,11 @@ class ServeConfig:
                 raise ConfigError(
                     f"tenant weight for {t!r} must be > 0, got {w!r}"
                 )
+        if self.prewarm_policy not in ("auto", "all"):
+            raise ConfigError(
+                f"prewarm_policy must be 'auto' or 'all', got "
+                f"{self.prewarm_policy!r}"
+            )
         if not (0 < self.prewarm_min_keys <= self.prewarm_max_keys):
             raise ConfigError(
                 "prewarm range must satisfy 0 < min <= max, got "
@@ -398,7 +434,9 @@ class SortConfig:
         plus framework keys (``NUM_WORKERS``, ``KEY_DTYPE``, ``OVERSAMPLE``,
         ``CAPACITY_FACTOR``, ``PAYLOAD_BYTES``, ``HEARTBEAT_TIMEOUT_S``,
         ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``, ``EXCHANGE``,
-        ``REDUNDANCY``, ``TENANT``, ``FLIGHT_DIR``) and serving-layer keys
+        ``REDUNDANCY``, ``TENANT``, ``FLIGHT_DIR``, ``AUTOTUNE`` — the
+        closed-loop planner switch; a knob key PRESENT in the mapping is
+        explicit and never planner-overridden) and serving-layer keys
         (``SERVE_QUEUE_DEPTH``, ``SERVE_TENANT_INFLIGHT``,
         ``SERVE_SLICE_DEVICES``, ``SERVE_SMALL_JOB_MAX``,
         ``SERVE_WEIGHTS`` — ``tenant=weight,...`` — ``SERVE_PREWARM``,
@@ -414,6 +452,18 @@ class SortConfig:
         mesh = MeshConfig(
             num_workers=geti("NUM_WORKERS", None),
             dp=geti("DP", 1),
+        )
+        # The tri-state's conf half: a key PRESENT in the mapping is an
+        # explicit user choice the planner must not override (obs.plan);
+        # a key absent rides the dataclass default and stays plannable.
+        _EXPLICIT_KEYS = {
+            "EXCHANGE": "exchange",
+            "REDUNDANCY": "redundancy",
+            "EXTERNAL_WAVE_ELEMS": "wave_elems",
+            "SERVE_PREWARM": "prewarm",
+        }
+        explicit = tuple(
+            sorted(knob for key, knob in _EXPLICIT_KEYS.items() if key in m)
         )
         # Numeric fallbacks reference the dataclass defaults so a tuning
         # there can never silently diverge from the conf-file path.
@@ -434,6 +484,9 @@ class SortConfig:
             checkpoint_dir=m.get("CHECKPOINT_DIR") or None,
             tenant=m.get("TENANT", JobConfig.tenant),
             flight_recorder_dir=m.get("FLIGHT_DIR") or None,
+            autotune=m.get("AUTOTUNE", "0").strip().lower()
+            in ("1", "true", "yes"),
+            explicit=explicit,
         )
         from dsort_tpu.serve.fair import parse_weights
 
@@ -446,7 +499,12 @@ class SortConfig:
             small_job_max=geti("SERVE_SMALL_JOB_MAX", None),
             tenant_weights=parse_weights(m.get("SERVE_WEIGHTS")),
             prewarm=m.get("SERVE_PREWARM", "0").strip().lower()
-            in ("1", "true", "yes"),
+            in ("1", "true", "yes", "all"),
+            prewarm_policy=(
+                "all"
+                if m.get("SERVE_PREWARM", "").strip().lower() == "all"
+                else "auto"
+            ),
             slo_shed_ms=(
                 float(m["SERVE_SLO_SHED_MS"])
                 if "SERVE_SLO_SHED_MS" in m else None

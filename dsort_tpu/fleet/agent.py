@@ -360,8 +360,10 @@ class FleetAgent:
                 self._send({"type": "rejected", "job_id": jid,
                             "reason": f"bad_payload: {e}"})
                 return
+            red = header.get("redundancy")
             verdict, ticket = self.service.submit(
-                data, tenant=tenant, job_id=label
+                data, tenant=tenant, job_id=label,
+                redundancy=int(red) if red is not None else None,
             )
             if not verdict.admitted:
                 self._send({"type": "rejected", "job_id": jid,

@@ -191,6 +191,8 @@ class FleetController:
         health_telemetry: bool = True,
         degraded_score: float = 1.5,
         flight_dir: str | None = None,
+        autotune: bool = False,
+        redundancy: int | None = None,
         start: bool = True,
     ):
         if routing not in ROUTING_POLICIES:
@@ -255,6 +257,19 @@ class FleetController:
             degraded_score=degraded_score, slo_ms=slo_shed_ms,
         )
         self._degraded: dict[str, bool] = {}
+        # Closed-loop redundancy policy (obs.plan, ARCHITECTURE §15): with
+        # autotune on and no explicit ``redundancy``, every dispatch stamps
+        # a planned ``r`` into its submit header, sized from the observed
+        # loss rate + the rolling health verdicts.  An explicit value wins
+        # and journals a plan_override.  The planner rides the controller
+        # journal's own events (health_verdict, agent-loss reroutes), so
+        # its state replays from the journal alone.
+        from dsort_tpu.obs.plan import Planner
+
+        self.autotune = bool(autotune)
+        self.redundancy = int(redundancy) if redundancy is not None else None
+        self.planner = Planner()
+        self.planner.attach(self._svc_metrics)
         self.flight = None
         if flight_dir:
             from dsort_tpu.obs.flight import FlightRecorder
@@ -773,6 +788,7 @@ class FleetController:
         metrics = Metrics(journal=self.journal)
         if self.telemetry is not None:
             self.telemetry.attach(metrics)
+        self.planner.attach(metrics)
         with self._cv:
             self._seq += 1
             # Scoped by controller identity: a NEW incarnation running
@@ -1005,15 +1021,38 @@ class FleetController:
                     link.dispatching -= 1
                     self._cv.notify_all()
 
+    def _plan_redundancy(self, job: _Job) -> int | None:
+        """The per-dispatch redundancy decision (obs.plan's policy 3).
+
+        Returns the ``r`` to stamp into the submit header, or None (no
+        stamp: the agent's own ``JobConfig.redundancy`` applies).  An
+        explicit controller-level value always wins — with autotune on the
+        yield is journaled as a ``plan_override``.
+        """
+        if not self.autotune:
+            return self.redundancy
+        inputs = self.planner.redundancy_inputs(
+            current=self.redundancy or 1, scores=self.health.scores(),
+        )
+        if self.redundancy is not None:
+            return int(self.planner.note_override(
+                "redundancy", self.redundancy, inputs, job.ticket.metrics,
+            ))
+        return int(self.planner.decide(
+            "redundancy", inputs, job.ticket.metrics,
+        ))
+
     def _dispatch_one(self, link: _AgentLink, job: _Job) -> None:
         jid, tenant = job.jid, job.tenant
         try:
             payload_arr = self._job_payload(job)
             meta, payload = encode_array(payload_arr)
+            planned_r = self._plan_redundancy(job)
+            red = {} if planned_r is None else {"redundancy": int(planned_r)}
             header, _ = self._request(
                 link,
                 {"type": "submit", "job_id": jid, "tenant": tenant,
-                 "label": job.label, **meta},
+                 "label": job.label, **red, **meta},
                 payload,
                 timeout=self.dispatch_timeout_s,
                 expect=("accepted", "rejected"),

@@ -625,6 +625,21 @@ class ExternalWaveSort:
             "job_start", mode="wave_external", n_keys=n, job_id=self.job_id,
             tenant=self.job.tenant,
         )
+        if getattr(self.job, "autotune", False):
+            # Wave sizing from the journal's hbm_watermark ledger instead
+            # of the hand-set wave_elems (obs.plan, ARCHITECTURE §15).
+            # Journaled BEFORE the manifest sync, so a resized resume
+            # restarts cleanly under the manifest's wave_elems check.
+            from dsort_tpu.obs.plan import planned_wave_elems
+
+            records = (
+                [e.to_dict() for e in metrics.journal.events()]
+                if metrics.journal is not None else []
+            )
+            self.wave_elems = planned_wave_elems(
+                self.job, self.wave_elems, storage.itemsize, records,
+                metrics,
+            )
         num_waves = -(-n // self.wave_elems)
         with timer.phase("splitter_sample"):
             splitters = sample_global_splitters(

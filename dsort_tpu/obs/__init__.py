@@ -42,6 +42,19 @@ PR 14 adds the LIVE half of why-slow (ARCHITECTURE §13):
   phase, queue/compile/execute split, SLO-breach risk) that drive
   ``routing="health"``, the per-agent ``/metrics`` gauges, the ``dsort
   top`` health pane, and the degraded->flight-bundle contract.
+
+PR 16 closes the loop (ARCHITECTURE §15):
+
+- `obs.plan`: the planner plane — a backend-free `Planner` that folds the
+  already-journaled signals (``skew_report``/probe skew, ``hbm_watermark``,
+  ``job_admitted``, rolling health verdicts) into typed, replayable
+  ``plan_decision`` events BEFORE dispatch: exchange selection, wave
+  sizing, redundancy ``r``, and prewarm-set prediction.  Every decision
+  carries its measured inputs + rejected alternatives; ``dsort report
+  --analyze`` replays each one (the ``plan`` verdict key), ``/metrics``
+  exports per-policy decision/override gauges, ``dsort top`` grows a
+  planner pane, and explicit flags always win (journaled
+  ``plan_override``; ``--no-autotune`` disables the plane entirely).
 """
 
 from dsort_tpu.obs.analyze import (  # noqa: F401
@@ -62,6 +75,15 @@ from dsort_tpu.obs.flight import (  # noqa: F401
     FlightRecorder,
 )
 from dsort_tpu.obs.histogram import LatencyHistogram  # noqa: F401
+from dsort_tpu.obs.plan import (  # noqa: F401
+    PLAN_DECISION_FIELDS,
+    PLAN_OVERRIDE_FIELDS,
+    PLAN_POLICIES,
+    Planner,
+    plan_table,
+    probe_skew,
+    replay_decision,
+)
 from dsort_tpu.obs.merge import (  # noqa: F401
     group_rotated,
     merge_journals,
@@ -96,6 +118,10 @@ __all__ = [
     "LatencyHistogram",
     "MemWatch",
     "MetricsServer",
+    "PLAN_DECISION_FIELDS",
+    "PLAN_OVERRIDE_FIELDS",
+    "PLAN_POLICIES",
+    "Planner",
     "RECOVERY_EVENTS",
     "SHARED_VERDICT_KEYS",
     "SLO_QUANTILES",
@@ -112,8 +138,11 @@ __all__ = [
     "merge_journals",
     "merge_records",
     "parse_prometheus_text",
+    "plan_table",
+    "probe_skew",
     "read_journal",
     "read_journal_set",
+    "replay_decision",
     "rotated_set",
     "slo_from_journal",
     "variant_label",

@@ -37,6 +37,12 @@ trace, `obs.merge`) into one structured verdict:
   cost (``wave_resume`` missing-run totals).  The wave phases themselves
   (``wave_read``/``wave_sort``/``wave_exchange``/``wave_spill``/``merge``)
   land in the ordinary phase waterfall.
+- **plan**: the planner audit (ARCHITECTURE §15) — every ``plan_decision``
+  REPLAYED through its pure policy from the journaled inputs
+  (`obs.plan.replay_decision`); ``mismatches`` counts decisions whose
+  replay disagrees with what the live planner chose (pinned at 0: a
+  decision that cannot be reproduced from its recorded inputs is an audit
+  failure), plus the ``plan_override`` trail of explicit values that won.
 
 Every figure is derived from the records alone — the same replay
 discipline as `obs.slo`: analyzing a journal twice, or a scrape and a
@@ -66,6 +72,7 @@ VERDICT_KEYS = (
     "compiles",
     "waves",
     "recovery",
+    "plan",
 )
 
 
@@ -112,6 +119,8 @@ def analyze_records(
     wave_span: dict[tuple[int, object], float] = {}
     wave_done_at: dict[tuple[int, object], float] = {}
     wave_resumed = 0
+    plan_decisions: list[dict] = []
+    plan_overrides: list[dict] = []
     for r in recs:
         src = int(r.get("src", 0))
         src_end[src] = r["mono"]
@@ -180,6 +189,10 @@ def analyze_records(
         elif etype == "wave_resume":
             m = r.get("missing")
             wave_resumed += int(m) if isinstance(m, (int, float)) else 0
+        elif etype == "plan_decision":
+            plan_decisions.append(r)
+        elif etype == "plan_override":
+            plan_overrides.append(r)
         elif etype == "hbm_watermark":
             b = r.get("bytes_in_use", 0)
             if hbm_best is None or b > hbm_best.get("bytes_in_use", 0):
@@ -340,6 +353,49 @@ def analyze_records(
             "slowest": slowest_wave,
             "gating": gating,
         }
+    # -- plan: replay every planner decision from its journaled inputs ------
+    plan = None
+    if plan_decisions or plan_overrides:
+        from dsort_tpu.obs.plan import replay_decision
+
+        replayed = []
+        mismatches = 0
+        by_policy: dict[str, int] = {}
+        for d in plan_decisions:
+            policy = str(d.get("policy"))
+            inputs = d.get("inputs") or {}
+            by_policy[policy] = by_policy.get(policy, 0) + 1
+            try:
+                rechosen, rejected = replay_decision(policy, inputs)
+            except (ValueError, TypeError, KeyError):
+                rechosen, rejected = None, []
+            match = rechosen == d.get("chosen")
+            if not match:
+                mismatches += 1
+            replayed.append({
+                "policy": policy,
+                "chosen": d.get("chosen"),
+                "replayed": rechosen,
+                "match": match,
+                "inputs": inputs,
+                "rejected": d.get("rejected") or rejected,
+            })
+        plan = {
+            "decisions": len(plan_decisions),
+            "overrides": len(plan_overrides),
+            "mismatches": mismatches,
+            "by_policy": by_policy,
+            "replayed": replayed,
+            "overridden": [
+                {
+                    "policy": o.get("policy"),
+                    "explicit": o.get("explicit"),
+                    "planned": o.get("planned"),
+                    "inputs": o.get("inputs") or {},
+                }
+                for o in plan_overrides
+            ],
+        }
     return {
         "span_s": round(t1 - t0, 6),
         "sources": {
@@ -372,6 +428,7 @@ def analyze_records(
         "compiles": ledger,
         "waves": waves,
         "recovery": recovery,
+        "plan": plan,
     }
 
 
@@ -455,6 +512,13 @@ def format_analysis(verdict: dict) -> str:
         if wv.get("resumed_runs"):
             bits.append(f"{wv['resumed_runs']} runs re-sorted on resume")
         lines.append("  waves         : " + ", ".join(bits))
+    pl = verdict.get("plan")
+    if pl:
+        lines.append(
+            f"  plan          : {pl['decisions']} decision(s), "
+            f"{pl['overrides']} override(s), "
+            f"{pl['mismatches']} replay mismatch(es)"
+        )
     sj = verdict.get("slowest_job")
     if sj:
         lines.append(
@@ -475,5 +539,34 @@ def format_analysis(verdict: dict) -> str:
                 f"{e['compile_s'] * 1e3:>10.1f} ms  "
                 f"{e['flops']:>14.3g} flops  "
                 f"{e['peak_hbm_bytes']:>12,} peak B"
+            )
+    pl = verdict.get("plan")
+    if pl:
+        # The audit trail: each decision replayed from its own inputs,
+        # with the winning reason — why the planner chose what it chose.
+        lines.append("planner decisions (replayed from journaled inputs):")
+        for d in pl.get("replayed", []):
+            chosen = d.get("chosen")
+            shown = (
+                f"[{len(chosen)} key(s)]"
+                if isinstance(chosen, (list, tuple)) else chosen
+            )
+            inputs = d.get("inputs") or {}
+            key_inputs = ", ".join(
+                f"{k}={inputs[k]}" for k in sorted(inputs)
+                if not isinstance(inputs[k], (list, dict))
+            )
+            ok = "ok" if d.get("match") else "MISMATCH"
+            lines.append(
+                f"  {d.get('policy'):<12} -> {shown}  [{ok}]  {key_inputs}"
+            )
+            for rej in (d.get("rejected") or [])[:2]:
+                lines.append(
+                    f"    rejected {rej.get('value')}: {rej.get('reason')}"
+                )
+        for o in pl.get("overridden", []):
+            lines.append(
+                f"  {o.get('policy'):<12} OVERRIDDEN: explicit "
+                f"{o.get('explicit')} beat planned {o.get('planned')}"
             )
     return "\n".join(lines) + "\n"

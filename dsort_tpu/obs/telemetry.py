@@ -293,6 +293,33 @@ class _TelemetryTap:
             sec = fields.get("seconds")
             if isinstance(sec, (int, float)):
                 tel._absorb_phase(fields.get("phase", "?"), sec)
+        elif etype in ("plan_decision", "plan_override"):
+            # The planner plane's gauges (ARCHITECTURE §15): per-policy
+            # decision/override counts plus an info-style series carrying
+            # the last chosen value — absorbed from the SAME journaled
+            # events the plan verdict replays, wherever telemetry is
+            # attached (serve, fleet, CLI), zero extra wiring.
+            policy = str(fields.get("policy", "?"))
+            which = (
+                "plan_decisions" if etype == "plan_decision"
+                else "plan_overrides"
+            )
+            with tel._lock:
+                k = (which, (("policy", policy),))
+                _, cur = tel._series.get(k, ((), 0.0))
+                tel._series[k] = ((("policy", policy),), cur + 1.0)
+            if etype == "plan_decision":
+                chosen = fields.get("chosen")
+                shown = (
+                    f"[{len(chosen)} keys]"
+                    if isinstance(chosen, (list, tuple)) else str(chosen)
+                )
+                tel.set_series(
+                    "plan_info",
+                    {"policy": policy, "chosen": shown},
+                    1.0,
+                    key={"policy": policy},
+                )
         # The SLO machine consumes job_start BEFORE the outcome branches
         # above pop its state, and job_done after — step() order matters
         # only relative to its own reads, so one call at the end suffices.

@@ -63,6 +63,38 @@ def render_health(parsed: dict) -> list[str]:
     return ["health:"] + health_table(rows, indent="  ")
 
 
+def _plan_rows(parsed: dict) -> list[tuple]:
+    """Per-policy planner cells from the scrape's labeled gauges
+    (`obs.plan` via the telemetry tap, ARCHITECTURE §15)."""
+    rows: dict[str, dict] = {}
+    for metric, field in (
+        ("dsort_plan_decisions", "decisions"),
+        ("dsort_plan_overrides", "overrides"),
+    ):
+        for labels, value in _labeled(parsed, metric):
+            rows.setdefault(labels.get("policy", "?"), {})[field] = value
+    for labels, _value in _labeled(parsed, "dsort_plan_info"):
+        row = rows.setdefault(labels.get("policy", "?"), {})
+        row["last"] = labels.get("chosen", "-")
+    return [
+        (policy, row.get("decisions", 0), row.get("overrides", 0),
+         row.get("last"))
+        for policy, row in sorted(rows.items())
+    ]
+
+
+def render_plan(parsed: dict) -> list[str]:
+    """The planner-pane lines (empty when the scrape has no planner
+    plane).  One shared table formatter with the report-side renderer
+    (`obs.plan.plan_table`) — the two panes cannot drift."""
+    from dsort_tpu.obs.plan import plan_table
+
+    rows = _plan_rows(parsed)
+    if not rows:
+        return []
+    return ["planner:"] + plan_table(rows, indent="  ").splitlines()
+
+
 def render_top(parsed: dict) -> str:
     """The console snapshot for one parsed scrape."""
     lines = []
@@ -72,6 +104,7 @@ def render_top(parsed: dict) -> str:
         f"jobs in flight: {int(in_flight)}    queue depth: {int(queue)}"
     )
     lines.extend(render_health(parsed))
+    lines.extend(render_plan(parsed))
     # Compiled-variant cache (serving layer): entries/hits/misses/prewarmed
     # ride as gauges; the hit rate is the headline the operator watches.
     hits = parsed.get(("dsort_variant_cache_hits", ()), 0.0)

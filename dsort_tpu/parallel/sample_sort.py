@@ -979,9 +979,26 @@ class SampleSort:
         replica plane rides the ring's ppermute steps (`parallel.coded`) —
         the padded all_to_all has no per-step seam to ship replicas on, and
         the fused kernel carries no replica slots yet.
+
+        With ``job.autotune`` on, the exchange schedule is PLANNED here —
+        per dispatch, from a measured skew probe of this job's actual keys
+        (obs.plan, ARCHITECTURE §15) — unless the user set it explicitly
+        (per-call ``exchange=`` or an ``explicit``-marked config value), in
+        which case the explicit value wins and a ``plan_override`` is
+        journaled.
         """
-        exch = self._resolve_exchange(exchange)
         red = self._resolve_redundancy(redundancy)
+        if getattr(self.job, "autotune", False):
+            from dsort_tpu.obs.plan import planned_exchange
+
+            fused_ok = all(
+                d.platform == "tpu" for d in self.mesh.devices.flat
+            )
+            exchange = planned_exchange(
+                self.job, data, self.num_workers, metrics,
+                call_value=exchange, fused_ok=fused_ok, redundancy=red,
+            )
+        exch = self._resolve_exchange(exchange)
         if red > 1 and exch != "ring":
             log.warning(
                 "redundancy=%d needs the lax ring schedule; overriding "

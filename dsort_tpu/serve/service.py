@@ -73,6 +73,9 @@ class JobTicket:
         self.metrics = metrics
         self.n_keys = len(data)
         self.readmits = 0
+        # Per-job redundancy override (the fleet planner's r, obs.plan);
+        # None = JobConfig.redundancy.
+        self.redundancy: int | None = None
         # Coded redundancy (ARCHITECTURE §14): a coded job evicted by a
         # device loss parks its replica snapshot here; the re-dispatch then
         # completes from replica slots instead of re-running the sort.
@@ -176,11 +179,20 @@ class SortService:
         # delta collector rides here — the events that land in the agent's
         # journal feed the streamed telemetry deltas identically).
         self.job_taps: list = []
+        # Closed-loop planner (obs.plan, ARCHITECTURE §15): rides the job
+        # taps so every admitted job's events (the admission rung x dtype
+        # mix, hbm watermarks) fold into its rolling control inputs; the
+        # prewarm pass asks it for the predicted variant set.
+        from dsort_tpu.obs.plan import Planner
+
+        self.planner = Planner(job=self.job)
+        self.job_taps.append(self.planner)
         # Service-level metrics: rejections and lifecycle events that have
         # no per-job Metrics to ride on.
         self._svc_metrics = Metrics(journal=journal)
         if telemetry is not None:
             telemetry.attach(self._svc_metrics)
+        self.planner.attach(self._svc_metrics)
         self.flight = None
         if self.job.flight_recorder_dir:
             from dsort_tpu.obs.flight import FlightRecorder
@@ -238,13 +250,17 @@ class SortService:
         tenant: str | None = None,
         job_id: str | None = None,
         ckpt_job_id: str | None = None,
+        redundancy: int | None = None,
     ) -> tuple[Admission, JobTicket | None]:
         """Admit one keys-only sort job; returns ``(verdict, ticket)``.
 
         Non-blocking: backpressure is the verdict, not a blocked caller.
         ``job_id`` is a client label (journal only); ``ckpt_job_id``
         additionally routes the job through the checkpointed full-mesh
-        path when ``JobConfig.checkpoint_dir`` is set.
+        path when ``JobConfig.checkpoint_dir`` is set.  ``redundancy``
+        is a per-job override of ``JobConfig.redundancy`` — the fleet
+        controller's planned ``r`` (obs.plan's redundancy policy) arrives
+        here via the dispatch header.
         """
         data = np.asarray(data)
         tenant = tenant or self.job.tenant
@@ -271,10 +287,11 @@ class SortService:
         for tap in list(self.job_taps):
             tap.attach(metrics)
         ticket = JobTicket(data, tenant, job_id, ckpt_job_id, metrics)
+        ticket.redundancy = redundancy
         metrics.bump("jobs_admitted")
         metrics.event(
             "job_admitted", tenant=tenant, queue_depth=verdict.queue_depth,
-            n_keys=len(data),
+            n_keys=len(data), dtype=str(data.dtype),
         )
         # The SLO 'admit' stamp: job_start at ADMISSION time, so the
         # existing admit_to_dispatch histogram IS the queue wait.  The
@@ -404,7 +421,8 @@ class SortService:
         )
         self._publish_gauges()
         return self._sched.sort(
-            ticket.data, metrics=m, job_id=ticket.ckpt_job_id
+            ticket.data, metrics=m, job_id=ticket.ckpt_job_id,
+            redundancy=getattr(ticket, "redundancy", None),
         )
 
     def _sort_small(self, ticket: JobTicket, sid: int) -> np.ndarray:
@@ -646,13 +664,20 @@ class SortService:
     # -- variant prewarm ----------------------------------------------------
 
     def prewarm(self, sizes=None) -> int:
-        """Compile the capacity ladder's fused rungs before traffic.
+        """Compile the warm fused variants before traffic.
 
-        ``sizes`` (key counts; default: every ladder rung in
+        ``sizes`` (key counts; default: the ladder rungs in
         ``[serve.prewarm_min_keys, serve.prewarm_max_keys]``) map to their
-        rungs, compile once per rung, and execute once on every slice's
-        lead device so per-device executables exist too.  Returns the
-        number of fresh rungs compiled.
+        rungs, compile once per (rung, dtype), and execute once on every
+        slice's lead device so per-device executables exist too.  Returns
+        the number of fresh variants compiled.
+
+        With ``serve.prewarm_policy == "auto"`` (the default) and no
+        explicit ``sizes``, the set is the PLANNER's prediction from the
+        admission stream's recent rung x dtype mix (obs.plan's prewarm
+        policy — journaled as a ``plan_decision``); a cold start with no
+        history predicts the full ladder.  ``"all"`` (``--prewarm all``)
+        keeps the old exhaustive-ladder behavior.
         """
         if self._runner is not None:
             return 0
@@ -661,24 +686,40 @@ class SortService:
         from dsort_tpu.models.pipelines import _fused_small_fn, pad_rung
         from dsort_tpu.parallel.exchange import ladder_rungs
 
+        dtype_str = str(np.dtype(self.job.key_dtype))
         if sizes is None:
-            rungs = ladder_rungs(
+            ladder = ladder_rungs(
                 self.serve.prewarm_max_keys, lo=self.serve.prewarm_min_keys
             )
+            if self.serve.prewarm_policy == "auto":
+                chosen = self.planner.decide(
+                    "prewarm",
+                    self.planner.prewarm_inputs(ladder, dtype_str),
+                    self._svc_metrics,
+                )
+                pairs = []
+                for lbl in chosen:
+                    r, _, dt = str(lbl).partition(":")
+                    pairs.append((int(r), dt or dtype_str))
+            else:
+                pairs = [(int(r), dtype_str) for r in ladder]
         else:
-            rungs = sorted({pad_rung(max(int(n), 1)) for n in sizes})
-        dtype_str = str(np.dtype(self.job.key_dtype))
+            pairs = [
+                (pad_rung(max(int(n), 1)), dtype_str) for n in sizes
+            ]
+        pairs = sorted(set(pairs))
         kernel = self.job.local_kernel
         leads = [g[0] for g in self._slices.values()]
         fresh = 0
-        for rung in rungs:
-            key = fused_variant_key(rung, dtype_str, kernel)
+        rungs = sorted({r for r, _ in pairs})
+        for rung, dt in pairs:
+            key = fused_variant_key(rung, dt, kernel)
             fn, built = self.variants.prewarm(
-                key, lambda r=rung: _fused_small_fn(r, dtype_str, kernel)
+                key, lambda r=rung, d=dt: _fused_small_fn(r, d, kernel)
             )
             # One execution per lead device: jit specializes per placement,
             # so compiling on device 0 alone would leave 7 cold slices.
-            zero = np.zeros(rung, np.dtype(self.job.key_dtype))
+            zero = np.zeros(rung, np.dtype(dt))
             for dev in leads:
                 np.asarray(fn(jax.device_put(zero, dev), np.int32(rung))[:1])
             if built:

@@ -149,6 +149,16 @@ EVENT_TYPES: dict[str, str] = {
                              "range's every holder dead too); recovery "
                              "degraded cleanly to the re-run path (dead, "
                              "redundancy)",
+    # Planner plane (obs.plan, ARCHITECTURE §15):
+    "plan_decision": "the closed-loop planner chose a knob value from "
+                     "measured inputs, journaled BEFORE dispatch (policy — "
+                     "one of obs.plan.PLAN_POLICIES, chosen, inputs — the "
+                     "measured dict the pure policy replays from, rejected "
+                     "— alternatives with reasons)",
+    "plan_override": "an explicit flag/conf value won over the planner "
+                     "while autotune was on (policy, explicit — the value "
+                     "that won, planned — what the planner would have "
+                     "chosen, inputs)",
     # Out-of-core wave pipeline (models.wave_sort, ARCHITECTURE §10):
     "wave_start": "one input wave entered the mesh pipeline "
                   "(wave, n_keys)",
@@ -236,6 +246,12 @@ COUNTERS: dict[str, str] = {
                            "(also charged to exchange_bytes_on_wire)",
     "coded_recovered_keys": "keys reconstructed from replica slots by "
                             "coded recoveries (merged, never re-sorted)",
+    "plan_decisions": "knob values the closed-loop planner chose from "
+                      "measured inputs (obs.plan; each journals a "
+                      "plan_decision)",
+    "plan_overrides": "explicit flag/conf values that won over the planner "
+                      "while autotune was on (each journals a "
+                      "plan_override)",
     "waves_sorted": "input waves run through the mesh exchange pipeline",
     "wave_runs_resorted": "(wave, run) store entries re-sorted by the "
                           "run-granular resume/repair path",

@@ -1,0 +1,44 @@
+"""Fixture: planner-plane discipline violations (DS201/DS202 + DS301).
+
+Models the closed-loop planner's two riskiest shapes: a rolling-signal
+fold (admission mix, watermark peak, loss count) whose state must stay
+lock-guarded with no blocking work under the lock (the skew probe is an
+O(sample log sample) host sort — holding the planner lock across it
+would serialize every concurrently-dispatching job's decision behind one
+probe), and a decision that must never be journaled from inside a traced
+program (the measured inputs would become trace-time constants and the
+``plan_decision`` would fire once per compile, not per dispatch — the
+replay contract would audit a decision that never happened).
+"""
+
+import threading
+import time
+
+import jax
+
+
+class PlannerState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._admissions = []
+        self._hbm_peak = 0
+
+    def fold(self, label):
+        with self._lock:
+            self._admissions.append(label)
+
+    def fold_racy(self, label):
+        self._admissions.append(label)  # DS201: guarded attribute, no lock
+
+    def decide_under_lock(self, probe, policy):
+        with self._lock:
+            time.sleep(0.01)  # DS202: the probe settle, lock held
+            return probe.wait()  # DS202: blocking skew probe under the lock
+
+
+@jax.jit
+def decide_inside_trace(x, metrics):
+    metrics.event("plan_decision", policy="exchange", chosen="ring")  # DS301
+    t0 = time.perf_counter()  # DS301: the probe clock baked in at trace
+    print("planned at", t0)  # DS301
+    return x + 1

@@ -1,0 +1,46 @@
+"""Fixture: near-miss clean twin of bad_plan — all discipline kept.
+
+The shapes `obs.plan` actually ships: lock held only for the rolling
+dict/deque state, the skew probe and the decision emission both OUTSIDE
+the lock, and the decision journaled AROUND the jitted dispatch, never
+inside it (the replay contract needs one ``plan_decision`` per dispatch
+with the inputs that dispatch measured).
+"""
+
+import threading
+import time
+
+import jax
+
+
+class PlannerState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._admissions = []
+        self._hbm_peak = 0
+
+    def fold(self, label):
+        with self._lock:
+            self._admissions.append(label)
+            self._hbm_peak = max(self._hbm_peak, len(label))
+
+    def inputs(self):
+        with self._lock:  # snapshot the rolling state under the lock ...
+            return {"history": list(self._admissions)}
+
+    def decide_outside_lock(self, probe, policy):
+        inputs = self.inputs()  # lock released inside inputs
+        return probe.run(inputs)  # the probe sort never holds the lock
+
+
+@jax.jit
+def pure_dispatch(x):
+    return x + 1
+
+
+def decide_around_trace(x, metrics):
+    t0 = time.perf_counter()  # host-side probe clock AROUND the traced call
+    y = pure_dispatch(x)
+    metrics.event("plan_decision", policy="exchange", chosen="ring",
+                  probe_s=time.perf_counter() - t0)
+    return y

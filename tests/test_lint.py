@@ -127,6 +127,18 @@ def test_coded_fixture():
     assert run_fixture("good_coded.py") == []
 
 
+def test_plan_fixture():
+    """ISSUE 16: the planner plane's discipline contract — the rolling
+    signal state stays lock-guarded with the skew probe outside the lock,
+    and no plan_decision (or probe clock) is emitted from inside a traced
+    function (the measured inputs would become trace-time constants and
+    the replay audit would replay a decision that never ran)."""
+    diags = run_fixture("bad_plan.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS201": 1, "DS202": 2, "DS301": 3}
+    assert run_fixture("good_plan.py") == []
+
+
 def test_durability_checker_fixture():
     """ISSUE 13: the PR 12 review-fix classes stay pinned — a raw write to
     a persisted-state path, a rename with no fsync, and persist IO under a
