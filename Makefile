@@ -37,6 +37,9 @@ fleet-smoke:  ## federated serving: 2 local agents behind a fleet controller, lo
 	$(PY) -m dsort_tpu.cli bench --fleet-mixed --n 20000 --reps 1 \
 	--journal /tmp/dsort_fleet_smoke.jsonl
 
+spec-smoke:  ## explicit-state model check of the fleet protocol (bounded, backend-free, seconds)
+	$(PY) -m dsort_tpu.cli spec check --max-states 12000
+
 profile-smoke:  ## introspection-plane cost proof: ring sort with vs without journal+ledger+memwatch (8-device cpu mesh)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m dsort_tpu.cli bench --analyze-smoke --n 1048576 --reps 2 \
@@ -85,4 +88,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke profile-smoke external-smoke coded-smoke autotune-smoke bench-compare bench-history native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke spec-smoke profile-smoke external-smoke coded-smoke autotune-smoke bench-compare bench-history native tsan asan ubsan sanitize

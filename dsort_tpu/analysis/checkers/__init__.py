@@ -16,6 +16,7 @@ from dsort_tpu.analysis.checkers.layers import LayersChecker
 from dsort_tpu.analysis.checkers.lifecycle import LifecycleChecker
 from dsort_tpu.analysis.checkers.protocol import ProtocolChecker
 from dsort_tpu.analysis.checkers.registry import RegistryChecker
+from dsort_tpu.analysis.checkers.spec import SpecChecker
 from dsort_tpu.analysis.checkers.tracing import TracingChecker
 
 
@@ -30,6 +31,7 @@ def all_checkers():
         DurabilityChecker(),
         ProtocolChecker(),
         LifecycleChecker(),
+        SpecChecker(),
     ]
 
 

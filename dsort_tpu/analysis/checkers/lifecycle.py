@@ -18,9 +18,12 @@ Codes
   DS902  an async remote copy drains only one direction (``wait_recv``
          without ``wait_send``, or vice versa) and never calls plain
          ``wait()``: the un-drained side races buffer reuse
-  DS903  a ``threading.Thread`` is created without ``daemon=True`` and
-         never ``.join()``ed anywhere in the module: it outlives its
-         owner and blocks interpreter exit
+  DS903  a thread-like resource leaks past its owner: a
+         ``threading.Thread`` created without ``daemon=True`` and never
+         ``.join()``ed, a ``threading.Timer`` never ``.cancel()``ed /
+         ``.join()``ed / marked daemon, or a ``concurrent.futures``
+         executor neither used as a context manager nor ``.shutdown()``
+         anywhere in the module
 
 Pairing is per enclosing function and per copy *factory*: the ring
 kernels build copies through a local ``def copy(k): return
@@ -29,7 +32,9 @@ pltpu.make_async_remote_copy(...)`` — ``copy(k).start()`` pairs with
 Direct ``make_async_remote_copy(...).start()`` chains and simple local
 bindings (``c = make_async_remote_copy(...)``) resolve the same way.
 Join detection for DS903 is module-wide by target name (threads are
-often created in ``__init__`` and joined in ``shutdown``).
+often created in ``__init__`` and joined in ``shutdown``); timers pair
+with ``.cancel()``/``.join()`` or a ``.daemon = True`` attribute set,
+executors with a ``with`` block or a module-wide ``.shutdown()``.
 """
 
 from __future__ import annotations
@@ -43,6 +48,8 @@ from dsort_tpu.analysis.engine import Checker, FileContext
 
 _DMA_FACTORY = "make_async_remote_copy"
 _WAIT_ATTRS = {"wait", "wait_recv", "wait_send"}
+_DRAIN_ATTRS = ("join", "cancel", "shutdown")
+_EXECUTORS = ("ThreadPoolExecutor", "ProcessPoolExecutor")
 
 
 class LifecycleChecker(Checker):
@@ -50,7 +57,7 @@ class LifecycleChecker(Checker):
     codes = {
         "DS901": "async remote copy started but never waited",
         "DS902": "async remote copy drains only one DMA direction",
-        "DS903": "non-daemon thread never joined",
+        "DS903": "thread/timer/executor leaks past its owner",
     }
     scope = ("*.py",)
 
@@ -157,60 +164,115 @@ class LifecycleChecker(Checker):
     # -- DS903 ---------------------------------------------------------------
 
     def _check_threads(self, ctx, fns) -> list[Diagnostic]:
-        # Module-wide join census: `.join()` receivers by name/attr.
-        joined_names: set[str] = set()
-        joined_attrs: set[str] = set()
+        # Module-wide drain census: receivers of .join()/.cancel()/
+        # .shutdown() by name and by attribute.
+        drains: dict[str, tuple[set[str], set[str]]] = {
+            a: (set(), set()) for a in _DRAIN_ATTRS
+        }
         for node in ast.walk(ctx.tree):
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "join"
+                and node.func.attr in _DRAIN_ATTRS
             ):
                 continue
+            names, attrs = drains[node.func.attr]
             recv = node.func.value
             if isinstance(recv, ast.Name):
-                joined_names.add(recv.id)
+                names.add(recv.id)
             elif isinstance(recv, ast.Attribute):
-                joined_attrs.add(recv.attr)
-        # Assignment targets per Thread call.
+                attrs.add(recv.attr)
+        # `t.daemon = True` attribute sets (the Timer idiom — Timer's
+        # constructor takes no daemon kwarg).
+        daemon_names: set[str] = set()
+        daemon_attrs: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and node.targets[0].attr == "daemon"
+                and isinstance(node.value, ast.Constant)
+                and node.value.value is True
+            ):
+                continue
+            recv = node.targets[0].value
+            if isinstance(recv, ast.Name):
+                daemon_names.add(recv.id)
+            elif isinstance(recv, ast.Attribute):
+                daemon_attrs.add(recv.attr)
+        # Assignment targets per constructor call, and `with Executor()
+        # as ex:` context expressions (scope-bounded drain by shape).
         targets: dict[int, ast.expr] = {}
+        with_calls: set[int] = set()
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Assign) and len(node.targets) == 1:
                 targets[id(node.value)] = node.targets[0]
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_calls.add(id(item.context_expr))
+
+        def drained(target, *attrs_wanted: str) -> bool:
+            pools = [drains[a] for a in attrs_wanted]
+            if isinstance(target, ast.Name):
+                return any(target.id in names for names, _ in pools)
+            if isinstance(target, ast.Attribute):
+                return any(target.attr in attrs for _, attrs in pools)
+            # List-comprehension / loop-built resource sets: any drain
+            # call in the module keeps the loose pairing honest.
+            return any(names or attrs for names, attrs in pools)
+
         diags = []
         for node in ast.walk(ctx.tree):
-            if not (
-                isinstance(node, ast.Call)
-                and _callee_basename(node.func) == "Thread"
-            ):
+            if not isinstance(node, ast.Call):
                 continue
-            daemon = None
-            for kw in node.keywords:
-                if kw.arg == "daemon":
-                    daemon = kw.value
-            if (
-                daemon is not None
-                and isinstance(daemon, ast.Constant)
-                and daemon.value is True
-            ):
-                continue
-            target = targets.get(id(node))
-            ok = False
-            if isinstance(target, ast.Name):
-                ok = target.id in joined_names
-            elif isinstance(target, ast.Attribute):
-                ok = target.attr in joined_attrs
-            elif target is None:
-                # List-comprehension / loop-built thread sets: any .join()
-                # in the module keeps the loose pairing honest.
-                ok = bool(joined_names or joined_attrs)
-            if not ok:
+            callee = _callee_basename(node.func)
+            if callee in ("Thread", "Timer"):
+                daemon = None
+                for kw in node.keywords:
+                    if kw.arg == "daemon":
+                        daemon = kw.value
+                if (
+                    daemon is not None
+                    and isinstance(daemon, ast.Constant)
+                    and daemon.value is True
+                ):
+                    continue
+                target = targets.get(id(node))
+                if isinstance(target, ast.Name) and target.id in daemon_names:
+                    continue
+                if (
+                    isinstance(target, ast.Attribute)
+                    and target.attr in daemon_attrs
+                ):
+                    continue
+                wanted = ("join",) if callee == "Thread" else ("join", "cancel")
+                if drained(target, *wanted):
+                    continue
+                what = (
+                    "thread is neither daemon=True nor joined"
+                    if callee == "Thread"
+                    else "timer is neither daemon, cancelled, nor joined"
+                )
                 diags.append(
                     Diagnostic(
                         ctx.relpath, node.lineno, node.col_offset, "DS903",
-                        "thread is neither daemon=True nor joined anywhere "
-                        "in this module: it outlives its owner and blocks "
-                        "interpreter exit",
+                        f"{what} anywhere in this module: it outlives its "
+                        "owner and blocks interpreter exit",
+                    )
+                )
+            elif callee in _EXECUTORS:
+                if id(node) in with_calls:
+                    continue
+                if drained(targets.get(id(node)), "shutdown"):
+                    continue
+                diags.append(
+                    Diagnostic(
+                        ctx.relpath, node.lineno, node.col_offset, "DS903",
+                        f"{callee} is neither used as a context manager nor "
+                        ".shutdown() anywhere in this module: its worker "
+                        "threads outlive the owner and block interpreter "
+                        "exit",
                     )
                 )
         return diags

@@ -142,6 +142,12 @@ class LintConfig:
     admission_registry_path: str = os.path.join(
         "dsort_tpu", "serve", "admission.py"
     )
+    spec_registry_path: str = os.path.join(
+        "dsort_tpu", "analysis", "spec", "machines.py"
+    )
+    contracts_registry_path: str = os.path.join(
+        "dsort_tpu", "analysis", "spec", "contracts.py"
+    )
     layers: dict = dataclasses.field(default_factory=dict)
 
     def abspath(self, rel: str | None) -> str | None:
@@ -220,6 +226,10 @@ def load_config(root: str) -> LintConfig:
         cfg.proto_registry_path = table["proto_registry"]
     if "admission_registry" in table:
         cfg.admission_registry_path = table["admission_registry"]
+    if "spec_registry" in table:
+        cfg.spec_registry_path = table["spec_registry"]
+    if "contracts_registry" in table:
+        cfg.contracts_registry_path = table["contracts_registry"]
     if "layers" in table:
         cfg.layers = {
             str(mod): tuple(forbidden)

@@ -274,6 +274,7 @@ class ResultCache:
         for rel in (
             config.registry_path, config.native_map_path,
             config.proto_registry_path, config.admission_registry_path,
+            config.spec_registry_path, config.contracts_registry_path,
         ):
             path = config.abspath(rel)
             h.update(rel.encode())

@@ -1,0 +1,30 @@
+"""Spec plane (ARCHITECTURE §16): declarative protocol state machines,
+journal trace contracts, and an explicit-state model checker.
+
+Three cooperating pieces, all stdlib-only at import time (the analysis
+package's layer contract — DS601 — forbids jax/numpy here):
+
+- `machines` — the controller-side and agent-side job lifecycles and the
+  serve admission lifecycle as PURE-LITERAL typed state machines
+  (`PROTOCOL_SPEC`), cross-checked against the handler source by the
+  DS10xx checker family (`analysis/checkers/spec.py`).
+- `contracts` — the `TRACE_CONTRACTS` grammar registry: the per-recovery-
+  path event sequences the drill tests used to assert by hand, replayable
+  against any journal (`dsort report --conform`, the analyzer's
+  `conformance` verdict key, `assert_conformant` in tests) and linted by
+  the DS11xx family.
+- `model` — the bounded explicit-state model checker behind
+  `dsort spec check` / `make spec-smoke`: exhaustive interleavings of
+  frame delivery, duplication, agent death, and controller crash against
+  the safety invariant catalog, with minimized deterministically
+  replayable violation fixtures.
+"""
+
+from dsort_tpu.analysis.spec.contracts import (  # noqa: F401
+    CONTRACT_EXEMPT,
+    TRACE_CONTRACTS,
+    assert_conformant,
+    conformance_report,
+    format_conformance,
+)
+from dsort_tpu.analysis.spec.machines import PROTOCOL_SPEC  # noqa: F401

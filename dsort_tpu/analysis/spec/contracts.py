@@ -1,0 +1,293 @@
+"""Journal trace contracts: the declared grammar the drill journals obey.
+
+Before this module, every fault drill pinned its recovery path with a
+hand-rolled sequence literal (test_serve's eviction drill, test_coded's
+death→re-form→reconstruct ordering, test_fleet's restore-before-dispatch
+check) — one interleaving each, duplicated across the test tree, and
+silently stale the moment an emission site moved.  `TRACE_CONTRACTS`
+declares those sequences ONCE as a grammar over `utils.events.EVENT_TYPES`
+names; the engine here replays any journal against it
+(`dsort report --conform`, the analyzer's `conformance` verdict, and
+`assert_conformant` in tests), and the DS11xx lint family keeps the
+registry honest both ways: every `.event(...)` emission site belongs to a
+declared contract (or is explicitly exempt), and every name a contract
+mentions resolves against `EVENT_TYPES`.
+
+Grammar: each contract is ``{"scope": (...), "when": (...), "steps":
+(...)}``.  ``steps`` joins into one regular expression over event names —
+tokens are event names plus ``( ) | ? * +`` — matched against the scoped
+trace: records grouped by ``(src, *scope-fields)``, filtered to the
+contract's alphabet (the set of names the steps mention), in journal
+order.  ``when`` gates applicability: a trace is only checked when it
+contains at least one trigger event, so an agent-side journal (which
+never admits) is not held to the admission prefix.  The whole registry is
+a PURE dict literal — the lint checker reads it by parsing this source,
+never importing it, the same discipline as every other registry.
+"""
+
+from __future__ import annotations
+
+import re
+
+#: The declared trace grammars (pure literal: parsed, not imported, by
+#: the DS11xx checker).  Names are contract ids surfaced in violations.
+TRACE_CONTRACTS = {
+    # The whole client-visible life of one job, serve-layer and
+    # fleet-controller alike (both stamp every event with the ticket's
+    # process-wide ``job`` ordinal): one admission verdict, dequeue/
+    # attempt rounds with eviction-readmission or reroute loops between
+    # them, at most one terminal, nothing after it.  `job_start` marks
+    # "entered a scheduler" and legally repeats per layer: serve stamps
+    # one at admission, the execution scheduler another after dequeue.
+    # This is the grammar the PR-8 eviction drill's hand literal
+    # unrolled one cycle of.
+    "job_lifecycle": {
+        "scope": ("job",),
+        "when": ("job_admitted", "job_rejected"),
+        "steps": (
+            "( job_rejected",
+            "| job_admitted job_start?",
+            "  ( job_dequeued job_start? attempt_start* job_routed?",
+            "    ( job_evicted job_readmitted | job_rerouted )? )*",
+            "  ( result_fetch* job_done result_fetch* | job_failed )?",
+            ")",
+        ),
+    },
+    # The §14 failure-posture ordering: every coded reconstruction is
+    # preceded by its trigger — the device death and mesh re-form on the
+    # SPMD path, or the eviction-readmission pair on the serve path
+    # (serve journals the loss as `job_evicted`, not `worker_dead`).
+    # Extra trigger pairs without a reconstruct are the re-run posture
+    # and legal in the same journal.
+    "coded_recovery": {
+        "scope": (),
+        "when": ("coded_recover",),
+        "steps": (
+            "( worker_dead mesh_reform coded_recover?",
+            "| job_evicted job_readmitted coded_recover? )+",
+        ),
+    },
+    # The PR-12 restart contract, trace-side: a restarted controller
+    # announces `controller_restore` BEFORE it dequeues or routes
+    # anything — dispatch from a half-restored table is exactly the bug
+    # class the drill exists to catch.
+    "controller_restore": {
+        "scope": (),
+        "when": ("controller_restore",),
+        "steps": ("controller_restore ( job_dequeued | job_routed )*",),
+    },
+    # Wave spans pair up: a `wave_done` never precedes its wave's
+    # `wave_start`; a faulted wave may restart (another start) before it
+    # completes.  Scoped per (job, wave) — wave ids repeat across jobs.
+    "wave_span": {
+        "scope": ("job", "wave"),
+        "when": ("wave_start",),
+        "steps": ("( wave_start wave_done? )+",),
+    },
+    # Run-granular resume happens while the job is still live: no
+    # `wave_resume` after the job's terminal event.
+    "wave_resume": {
+        "scope": ("job",),
+        "when": ("wave_resume",),
+        "steps": ("wave_resume+ ( job_done | job_failed )?",),
+    },
+}
+
+#: Event types legitimately OUTSIDE any trace contract (telemetry,
+#: phase spans, one-shot markers with no ordering obligation).  DS1101
+#: flags an emission site whose event is in neither a contract alphabet
+#: nor this tuple; DS1102 checks these names resolve too.
+CONTRACT_EXEMPT = (
+    "heartbeat_lapse",
+    "probe",
+    "reassign",
+    "capacity_retry",
+    "transient_retry",
+    "checkpoint_persist",
+    "checkpoint_restore",
+    "checkpoint_clear",
+    "phase_start",
+    "phase_end",
+    "fused_fallback",
+    "worker_join",
+    "task_done",
+    "device_handle",
+    "device_handle_invalidated",
+    "device_validate",
+    "device_consume",
+    "exchange_step",
+    "exchange_resize",
+    "clock_sync",
+    "flight_dump",
+    "slice_retired",
+    "variant_prewarm",
+    "serve_drain",
+    "serve_stop",
+    "variant_compiled",
+    "skew_report",
+    "hbm_watermark",
+    "fused_exchange_launch",
+    "fused_exchange_step",
+    "agent_register",
+    "agent_heartbeat",
+    "health_verdict",
+    "agent_degraded",
+    "coded_replica_ship",
+    "coded_budget_exceeded",
+    "plan_decision",
+    "plan_override",
+)
+
+_TOKEN = re.compile(r"[A-Za-z_][A-Za-z0-9_]*|[()|?*+]|\s+")
+
+
+class ContractError(ValueError):
+    """A malformed contract: unknown token, unbalanced grammar."""
+
+
+def contract_names(contract: dict) -> frozenset[str]:
+    """The contract's alphabet: every event name its steps mention."""
+    names = set()
+    for step in contract["steps"]:
+        for tok in _tokens(step):
+            if tok not in "()|?*+":
+                names.add(tok)
+    return frozenset(names)
+
+
+def _tokens(step: str) -> list[str]:
+    out, pos = [], 0
+    while pos < len(step):
+        m = _TOKEN.match(step, pos)
+        if m is None:
+            raise ContractError(
+                f"bad character {step[pos]!r} in contract step {step!r}"
+            )
+        pos = m.end()
+        tok = m.group()
+        if not tok.isspace():
+            out.append(tok)
+    return out
+
+
+def compile_contract(contract: dict) -> re.Pattern:
+    """Steps -> one regex over ``name,``-encoded traces."""
+    parts = []
+    for step in contract["steps"]:
+        for tok in _tokens(step):
+            if tok == "(":
+                parts.append("(?:")
+            elif tok in ")|?*+":
+                parts.append(tok)
+            else:
+                # Wrap each name with its separator so a postfix ?/*/+
+                # binds to the whole token, not the trailing comma.
+                parts.append("(?:" + re.escape(tok) + ",)")
+    pattern = "".join(parts)
+    try:
+        return re.compile(pattern)
+    except re.error as e:
+        raise ContractError(
+            f"contract does not compile ({e}): {pattern!r}"
+        )
+
+
+def _as_records(journal) -> list[dict]:
+    """Accept an `EventLog`, a list of event objects, or record dicts."""
+    events = getattr(journal, "events", None)
+    if callable(events):
+        journal = events()
+    out = []
+    for r in journal:
+        if isinstance(r, dict):
+            out.append(r)
+        else:
+            out.append(r.to_dict())
+    return out
+
+
+def conformance_report(journal, contracts: dict | None = None) -> dict:
+    """Replay a journal against every declared contract.
+
+    Returns ``{"ok": bool, "checked": n_traces, "violations": [...],
+    "contracts": {name: {"checked": n, "violations": n}}}``.  A violation
+    row names the contract, the scope key of the offending trace, and the
+    trace itself — the journal's own evidence.
+    """
+    contracts = TRACE_CONTRACTS if contracts is None else contracts
+    records = _as_records(journal)
+    checked_total = 0
+    violations = []
+    per_contract = {}
+    for name, contract in contracts.items():
+        alphabet = contract_names(contract)
+        pattern = compile_contract(contract)
+        when = tuple(contract.get("when", ()))
+        scope = tuple(contract.get("scope", ()))
+        traces: dict[tuple, list[str]] = {}
+        for r in records:
+            etype = r.get("type")
+            if etype not in alphabet:
+                continue
+            key = (r.get("src", 0),) + tuple(r.get(f) for f in scope)
+            traces.setdefault(key, []).append(etype)
+        checked = 0
+        bad = 0
+        for key, trace in sorted(traces.items(), key=lambda kv: str(kv[0])):
+            if when and not any(t in when for t in trace):
+                continue
+            checked += 1
+            if pattern.fullmatch(",".join(trace) + ",") is None:
+                bad += 1
+                violations.append({
+                    "contract": name,
+                    "scope": dict(
+                        zip(("src",) + scope, key)
+                    ),
+                    "trace": list(trace),
+                })
+        per_contract[name] = {"checked": checked, "violations": bad}
+        checked_total += checked
+    return {
+        "ok": not violations,
+        "checked": checked_total,
+        "violations": violations,
+        "contracts": per_contract,
+    }
+
+
+def assert_conformant(journal, contracts: dict | None = None) -> dict:
+    """Test helper: raise `AssertionError` naming every violated
+    contract; returns the report so callers can add count asserts."""
+    report = conformance_report(journal, contracts)
+    if not report["ok"]:
+        lines = [
+            f"{len(report['violations'])} trace-contract violation(s):"
+        ]
+        for v in report["violations"]:
+            lines.append(
+                f"  {v['contract']} @ {v['scope']}: {' -> '.join(v['trace'])}"
+            )
+        raise AssertionError("\n".join(lines))
+    return report
+
+
+def format_conformance(report: dict) -> str:
+    """The human table behind ``dsort report --conform``."""
+    lines = [
+        f"trace conformance: {report['checked']} scoped trace(s) against "
+        f"{len(report['contracts'])} contract(s) — "
+        + ("OK" if report["ok"] else
+           f"{len(report['violations'])} VIOLATION(S)")
+    ]
+    for name, row in sorted(report["contracts"].items()):
+        lines.append(
+            f"  {name:<20} {row['checked']:>5} checked  "
+            f"{row['violations']:>3} violation(s)"
+        )
+    for v in report["violations"]:
+        lines.append(
+            f"  VIOLATED {v['contract']} @ {v['scope']}: "
+            + " -> ".join(v["trace"])
+        )
+    return "\n".join(lines) + "\n"

@@ -2579,6 +2579,20 @@ def cmd_report(args) -> int:
         records = journals[0]
     if skipped:
         log.warning("skipped %d malformed journal line(s)", skipped)
+    rc = 0
+    if args.conform:
+        # Trace-contract conformance (ARCHITECTURE §16): the journal
+        # replayed against the declared TRACE_CONTRACTS grammars.  A
+        # violation exits 1 — this is a gate, not a report.
+        from dsort_tpu.analysis.spec.contracts import (
+            conformance_report,
+            format_conformance,
+        )
+
+        conf = conformance_report(records)
+        print(format_conformance(conf), end="")
+        if not conf["ok"]:
+            rc = 1
     if args.analyze or args.analyze_json:
         from dsort_tpu.obs.analyze import analyze_records, format_analysis
 
@@ -2589,14 +2603,14 @@ def cmd_report(args) -> int:
             with open(args.analyze_json, "w", encoding="utf-8") as f:
                 _json.dump(verdict, f, indent=1)
             log.info("analysis verdict written to %s", args.analyze_json)
-    else:
+    elif not args.conform:
         print(format_report(records), end="")
     if args.chrome_trace:
         with open(args.chrome_trace, "w", encoding="utf-8") as f:
             _json.dump(to_chrome_trace(records), f)
         log.info("chrome trace written to %s (load in Perfetto / "
                  "chrome://tracing)", args.chrome_trace)
-    return 0
+    return rc
 
 
 def cmd_top(args) -> int:
@@ -2764,6 +2778,64 @@ def cmd_lint(args) -> int:
         format_json(diags) if args.format == "json" else format_text(diags)
     )
     return 1 if any(d.severity == "error" for d in diags) else 0
+
+
+def cmd_spec(args) -> int:
+    """Protocol spec plane (`dsort_tpu.analysis.spec`, ARCHITECTURE §16).
+
+    ``dsort spec check`` explores bounded interleavings of the fleet
+    protocol — frame delivery on FIFO links, retransmission, dispatch
+    timeouts, link death/re-attach, controller crash+restore — with the
+    REAL `ControlPolicy` embedded via its ``state_dict`` round-trip, and
+    checks every reached state against the safety invariant catalog
+    (`SPEC_INVARIANTS`).  A violation is minimized to a deterministic
+    schedule and (with ``--dump-fixture``) written as a replayable JSON
+    fixture.  Exit 0 = no violation in the explored space; 1 = violation.
+
+    ``dsort spec replay --fixture F`` re-executes a dumped schedule and
+    exits 0 iff it still reproduces its recorded invariant violation —
+    the regression contract for ``tests/data/spec/`` fixtures.
+
+    Backend-free by design: like ``lint``, this command never initializes
+    JAX (the model is pure control-plane state).
+    """
+    from dsort_tpu.analysis.spec.model import (
+        ModelConfig,
+        check_model,
+        dump_fixture,
+        format_result,
+        load_fixture,
+        replay_schedule,
+    )
+
+    seams = tuple(args.seam or ())
+    if args.action == "replay":
+        if not args.fixture:
+            raise SystemExit("dsort spec replay: --fixture is required")
+        schedule, cfg, fseams = load_fixture(args.fixture)
+        violation = replay_schedule(schedule, cfg, fseams)
+        if violation is None:
+            print(f"{args.fixture}: schedule no longer violates anything")
+            return 1
+        print(
+            f"{args.fixture}: reproduces {violation.invariant} after "
+            f"{len(violation.schedule)} action(s): {violation.detail}"
+        )
+        return 0
+    cfg = ModelConfig(
+        n_agents=args.agents, n_jobs=args.jobs,
+        max_duplications=args.duplications, max_deaths=args.deaths,
+        max_crashes=args.crashes,
+    )
+    result = check_model(
+        cfg, seams=seams, max_states=args.max_states,
+        max_depth=args.max_depth,
+    )
+    print(format_result(result, seams), end="")
+    if result.violation is not None and args.dump_fixture:
+        dump_fixture(args.dump_fixture, result.violation, cfg, seams)
+        log.info("violation fixture written to %s", args.dump_fixture)
+    return 0 if result.ok else 1
 
 
 def cmd_coordinator(args) -> int:
@@ -3186,6 +3258,10 @@ def main(argv=None) -> int:
                    help="measured link bandwidth (Mbit/s): prices the "
                         "journal's wire bytes into expected seconds in "
                         "the --analyze verdict")
+    p.add_argument("--conform", action="store_true",
+                   help="replay the journal(s) against the declared "
+                        "TRACE_CONTRACTS grammars (ARCHITECTURE §16) and "
+                        "exit 1 on any violated contract")
     p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser(
@@ -3225,6 +3301,40 @@ def main(argv=None) -> int:
                    help="project root (default: nearest pyproject.toml)")
     p.set_defaults(fn=cmd_lint)
 
+    p = sub.add_parser(
+        "spec",
+        help="protocol spec plane: explicit-state model check of the fleet "
+             "protocol, or replay of a violation fixture (ARCHITECTURE §16)",
+    )
+    p.add_argument("action", choices=["check", "replay"],
+                   help="check: explore bounded interleavings against the "
+                        "invariant catalog; replay: re-execute a dumped "
+                        "violation fixture deterministically")
+    p.add_argument("--fixture", help="fixture JSON for `replay`")
+    p.add_argument("--agents", type=int, default=2,
+                   help="modeled fleet size (default 2)")
+    p.add_argument("--jobs", type=int, default=3,
+                   help="jobs submitted in the model (default 3)")
+    p.add_argument("--duplications", type=int, default=1,
+                   help="frame retransmission budget (default 1)")
+    p.add_argument("--deaths", type=int, default=1,
+                   help="link-death budget (default 1)")
+    p.add_argument("--crashes", type=int, default=1,
+                   help="controller crash+restore budget (default 1)")
+    p.add_argument("--max-states", type=int, default=12_000,
+                   help="distinct-state exploration bound (default 12000 — "
+                        "the make spec-smoke bound)")
+    p.add_argument("--max-depth", type=int, default=40,
+                   help="schedule depth bound (default 40)")
+    p.add_argument("--seam", action="append",
+                   choices=["ack_before_persist", "nonatomic_reserve"],
+                   help="re-introduce a known-bad mutation (test seam); "
+                        "repeatable — the checker must find a violation")
+    p.add_argument("--dump-fixture",
+                   help="write the minimized violating schedule as a "
+                        "replayable JSON fixture here")
+    p.set_defaults(fn=cmd_spec)
+
     p = sub.add_parser("coordinator", help="native TCP coordinator + job REPL")
     common(p)  # provides --workers (cluster size; default 4 below)
     p.add_argument("--port", type=int)
@@ -3242,13 +3352,14 @@ def main(argv=None) -> int:
     p.set_defaults(fn=None)
 
     args = ap.parse_args(argv)
-    if args.cmd != "lint":
+    if args.cmd not in ("lint", "spec"):
         # 64-bit keys (int64/uint64 — BASELINE config #3, TeraSort prefixes)
         # need x64 mode before any backend use; the library is tested under
         # x64 (tests/conftest.py), so enable it for every execution command.
         # Routed through the compat shim (the one allowed call site — the
-        # analysis suite's DS501 enforces this); `lint` itself skips the
-        # toggle so static analysis never initializes a backend.
+        # analysis suite's DS501 enforces this); `lint` and `spec` skip
+        # the toggle so static analysis and model checking never
+        # initialize a backend.
         from dsort_tpu.utils.compat import set_x64
 
         set_x64(True)

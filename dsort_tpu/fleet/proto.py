@@ -25,6 +25,7 @@ importing the jitted pipeline that compiles it.
 from __future__ import annotations
 
 import json
+import math
 import struct
 
 import numpy as np
@@ -129,7 +130,12 @@ def recv_frame(sock):
         raise ProtocolError(f"unparseable frame header: {e}") from e
     if not isinstance(header, dict) or header.get("type") not in FRAME_TYPES:
         raise ProtocolError(f"unregistered frame: {header!r}")
-    plen = int(header.get("payload_len", 0))
+    try:
+        plen = int(header.get("payload_len", 0))
+    except (TypeError, ValueError, OverflowError) as e:
+        # A flipped byte can keep the JSON valid while turning the
+        # length into a list/string/inf — typed error, never a crash.
+        raise ProtocolError(f"malformed payload_len: {e}") from e
     if not 0 <= plen <= MAX_FRAME_BYTES:
         raise ProtocolError(f"implausible payload length {plen}")
     payload = _recv_exact(sock, plen) if plen else b""
@@ -149,9 +155,18 @@ def encode_array(a: np.ndarray) -> tuple[dict, bytes]:
 
 
 def decode_array(meta: dict, payload: bytes) -> np.ndarray:
-    dtype = np.dtype(meta["dtype"])
-    shape = tuple(int(s) for s in meta["shape"])
-    n = int(np.prod(shape)) if shape else 1
+    try:
+        dtype = np.dtype(meta["dtype"])
+        shape = tuple(int(s) for s in meta["shape"])
+    except (KeyError, TypeError, ValueError, OverflowError) as e:
+        raise ProtocolError(f"malformed array meta: {e}") from e
+    if any(s < 0 for s in shape):
+        # reshape(-1) would INFER a dimension and happily accept a
+        # payload of the wrong logical shape.
+        raise ProtocolError(f"negative dimension in shape {shape}")
+    # math.prod, not np.prod: a corrupt shape must not wrap at int64 and
+    # alias a plausible element count.
+    n = math.prod(shape) if shape else 1
     if n * dtype.itemsize != len(payload):
         raise ProtocolError(
             f"payload is {len(payload)} bytes but {shape} {dtype} needs "

@@ -43,6 +43,12 @@ trace, `obs.merge`) into one structured verdict:
   replay disagrees with what the live planner chose (pinned at 0: a
   decision that cannot be reproduced from its recorded inputs is an audit
   failure), plus the ``plan_override`` trail of explicit values that won.
+- **conformance**: the trace-contract verdict (ARCHITECTURE §16) — the
+  journal replayed against the declared `TRACE_CONTRACTS` grammars
+  (`analysis.spec.contracts`): scoped traces checked, and every
+  violation named by contract, scope, and the offending event sequence.
+  The same engine serves ``dsort report --conform`` and the drill tests'
+  ``assert_conformant``.
 
 Every figure is derived from the records alone — the same replay
 discipline as `obs.slo`: analyzing a journal twice, or a scrape and a
@@ -73,6 +79,7 @@ VERDICT_KEYS = (
     "waves",
     "recovery",
     "plan",
+    "conformance",
 )
 
 
@@ -396,6 +403,18 @@ def analyze_records(
                 for o in plan_overrides
             ],
         }
+    # Trace-contract conformance rides every verdict: the analyzer sees
+    # the whole record stream anyway, and a non-conformant journal makes
+    # every OTHER figure suspect (a trace that lost its job_dequeued also
+    # lost that job's queue wait).  Lazy import: the contract engine is
+    # stdlib-only, but analyze is importable without the analysis package
+    # on odd installs — a missing engine degrades to no verdict, loudly.
+    try:
+        from dsort_tpu.analysis.spec.contracts import conformance_report
+    except ImportError:  # pragma: no cover - partial install
+        conformance = None
+    else:
+        conformance = conformance_report(recs)
     return {
         "span_s": round(t1 - t0, 6),
         "sources": {
@@ -429,6 +448,7 @@ def analyze_records(
         "waves": waves,
         "recovery": recovery,
         "plan": plan,
+        "conformance": conformance,
     }
 
 
@@ -518,6 +538,15 @@ def format_analysis(verdict: dict) -> str:
             f"  plan          : {pl['decisions']} decision(s), "
             f"{pl['overrides']} override(s), "
             f"{pl['mismatches']} replay mismatch(es)"
+        )
+    conf = verdict.get("conformance")
+    if conf:
+        lines.append(
+            f"  conformance   : {conf['checked']} trace(s) against "
+            f"{len(conf['contracts'])} contract(s) — "
+            + ("OK" if conf["ok"]
+               else f"{len(conf['violations'])} VIOLATION(S) "
+                    f"({', '.join(sorted({v['contract'] for v in conf['violations']}))})")
         )
     sj = verdict.get("slowest_job")
     if sj:

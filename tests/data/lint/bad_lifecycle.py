@@ -31,3 +31,16 @@ def spawn_workers(fn):
     threading.Thread(target=fn).start()  # DS903: not daemon, never joined
     t = threading.Thread(target=fn)  # DS903
     t.start()
+
+
+def arm_watchdog(fn):
+    w = threading.Timer(5.0, fn)  # DS903: never cancelled/joined/daemonized
+    w.start()
+
+
+def leak_pool(fn, items):
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=4)  # DS903: no with, no shutdown
+    for it in items:
+        pool.submit(fn, it)

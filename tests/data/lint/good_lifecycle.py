@@ -41,3 +41,37 @@ def run_joined(fn, n):
         t.start()
     for t in threads:
         t.join()
+
+
+def arm_cancelled_watchdog(fn):
+    w = threading.Timer(5.0, fn)
+    w.start()
+    try:
+        fn()
+    finally:
+        w.cancel()  # timer drained before the owner returns
+
+
+def arm_daemon_watchdog(fn):
+    w = threading.Timer(5.0, fn)
+    w.daemon = True  # Timer takes no daemon kwarg; the attribute set pairs
+    w.start()
+
+
+def run_pooled(fn, items):
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=4) as pool:  # scope-bounded drain
+        for it in items:
+            pool.submit(fn, it)
+
+
+def run_owned_pool(fn, items):
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=4)
+    try:
+        for it in items:
+            pool.submit(fn, it)
+    finally:
+        pool.shutdown(wait=True)  # module-wide pairing by receiver name

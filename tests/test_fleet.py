@@ -17,6 +17,7 @@ import time
 import numpy as np
 import pytest
 
+from dsort_tpu.analysis.spec import assert_conformant
 from dsort_tpu.fleet import proto
 from dsort_tpu.fleet.agent import FleetAgent
 from dsort_tpu.fleet.controller import FleetController
@@ -575,6 +576,11 @@ def test_controller_restart_drill(tmp_path):
             starts[r.get("job_id")] = starts.get(r.get("job_id"), 0) + 1
     assert len(starts) == 6
     assert all(v == 1 for v in starts.values()), starts
+    # The restore-before-dispatch ordering is the declared
+    # `controller_restore` contract (ISSUE 17): the restarted controller
+    # announces itself BEFORE it dequeues or routes anything.
+    report = assert_conformant(merged)
+    assert report["contracts"]["controller_restore"]["checked"] == 1
     # The restart announced itself with the persisted counts.
     restore = next(r for r in merged if r["type"] == "controller_restore")
     assert restore["queued"] == 4 and restore["inflight"] == 2
@@ -635,6 +641,7 @@ def test_restart_requeues_job_lost_with_its_agent(tmp_path):
         assert "controller_restore" in types and "job_rerouted" in types
         rr = next(e for e in j2.events() if e.type == "job_rerouted")
         assert rr.fields["reason"] == "agent_lost"
+        assert_conformant(j2)  # restore announced before any dispatch
     finally:
         ctl2.shutdown(drain=True, timeout=30)
         b.close()

@@ -172,10 +172,15 @@ def test_protocol_checker_fixture():
 def test_lifecycle_checker_fixture():
     """ISSUE 13: the fused-ring DMA pairing contract — a started-never-
     waited copy, a half-drained copy — and thread daemon/join discipline;
-    the clean twin is the real kernel's start/fold/wait schedule."""
+    the clean twin is the real kernel's start/fold/wait schedule.
+    ISSUE 17 widened DS903 to Timer (cancel/join/daemon-attr pairing)
+    and concurrent.futures executors (with-block or .shutdown())."""
     diags = run_fixture("bad_lifecycle.py")
     counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
-    assert counts == {"DS901": 1, "DS902": 1, "DS903": 2}
+    assert counts == {"DS901": 1, "DS902": 1, "DS903": 4}
+    messages = [d.message for d in diags if d.code == "DS903"]
+    assert any("timer" in m for m in messages)
+    assert any("ThreadPoolExecutor" in m for m in messages)
     assert run_fixture("good_lifecycle.py") == []
 
 
@@ -269,7 +274,7 @@ def test_checker_catalog_is_documented():
     catalog = checker_catalog()
     assert set(catalog) == {
         "registry", "concurrency", "tracing", "exceptions", "compat",
-        "layers", "durability", "protocol", "lifecycle",
+        "layers", "durability", "protocol", "lifecycle", "spec",
     }
     arch = open(os.path.join(REPO, "ARCHITECTURE.md"), encoding="utf-8").read()
     for codes in catalog.values():

@@ -1,0 +1,544 @@
+"""Spec plane (ISSUE 17, ARCHITECTURE §16): trace contracts, the
+explicit-state model checker, frame-decoder fuzz, and the DS10xx/DS11xx
+cross-checks.
+
+The load-bearing properties pinned here:
+  - the contract engine's grammar compiles with postfix operators bound
+    to whole names (the `job_start?` regression), scopes traces, and
+    names the violated contract on a tampered journal;
+  - the model checker explores >= 10,000 distinct states at the smoke
+    bound with ZERO violations on the real protocol, and BOTH seeded
+    PR-12 mutations are caught, with committed fixtures that replay
+    deterministically (the checker is not green-by-construction);
+  - every seeded byte mutation of every FRAME_TYPES frame fails TYPED
+    (`ProtocolError`) — never a hang, never an allocation past the
+    header bound; failing seeds persist as fixtures next to the
+    minimized schedules;
+  - a seeded spec<->handler drift (one deleted handler arm) is caught
+    statically, and the lint cache key tracks the spec sources.
+"""
+
+import json
+import os
+import random
+import shutil
+import struct
+
+import numpy as np
+import pytest
+
+from dsort_tpu.analysis.checkers import all_checkers
+from dsort_tpu.analysis.core import LintConfig, load_config
+from dsort_tpu.analysis.engine import ResultCache, lint_paths
+from dsort_tpu.analysis.spec import (
+    CONTRACT_EXEMPT,
+    PROTOCOL_SPEC,
+    TRACE_CONTRACTS,
+    assert_conformant,
+    conformance_report,
+    format_conformance,
+)
+from dsort_tpu.analysis.spec.contracts import (
+    ContractError,
+    compile_contract,
+    contract_names,
+)
+from dsort_tpu.analysis.spec.model import (
+    SEAMS,
+    ModelConfig,
+    check_model,
+    load_fixture,
+    replay_schedule,
+)
+from dsort_tpu.fleet import proto
+from dsort_tpu.utils.events import EVENT_TYPES, EventLog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "data", "spec")
+
+
+# -- trace contracts: the engine ---------------------------------------------
+
+
+def test_contract_registry_resolves_against_event_types():
+    """Every name a contract mentions (steps, when, exempt) is a
+    registered event type, and no name is both covered and exempt —
+    the same both-ways discipline DS1102 enforces statically."""
+    covered = set()
+    for name, contract in TRACE_CONTRACTS.items():
+        alphabet = contract_names(contract)
+        covered |= alphabet
+        for n in alphabet | set(contract.get("when", ())):
+            assert n in EVENT_TYPES, f"{name} mentions unregistered {n!r}"
+    for n in CONTRACT_EXEMPT:
+        assert n in EVENT_TYPES, f"exempt name {n!r} unregistered"
+    assert not covered & set(CONTRACT_EXEMPT)
+
+
+def test_compile_postfix_binds_to_whole_name():
+    """`b?` must make the NAME optional, not the separator — the
+    regression behind the first real-journal violation this PR hit."""
+    pat = compile_contract({"steps": ("alpha beta?",)})
+    assert pat.fullmatch("alpha,")
+    assert pat.fullmatch("alpha,beta,")
+    assert not pat.fullmatch("beta,")
+    pat = compile_contract({"steps": ("( alpha | beta )+ gamma*",)})
+    assert pat.fullmatch("beta,alpha,gamma,gamma,")
+    assert not pat.fullmatch("gamma,")
+
+
+def test_compile_rejects_garbage():
+    with pytest.raises(ContractError):
+        compile_contract({"steps": ("alpha [beta]",)})
+    with pytest.raises(ContractError):
+        compile_contract({"steps": ("( alpha",)})  # unbalanced
+
+
+def _lifecycle(log, job, evict=False, fail=False):
+    log.emit("job_admitted", job=job, tenant="t")
+    log.emit("job_dequeued", job=job, tenant="t")
+    log.emit("attempt_start", job=job, attempt=1)
+    if evict:
+        log.emit("job_evicted", job=job)
+        log.emit("job_readmitted", job=job)
+        log.emit("job_dequeued", job=job, tenant="t")
+        log.emit("attempt_start", job=job, attempt=2)
+    if fail:
+        log.emit("job_failed", job=job)
+    else:
+        log.emit("job_done", job=job)
+        log.emit("result_fetch", job=job)
+
+
+def test_conformance_scopes_interleaved_jobs():
+    """Two jobs interleaved in one journal are split into per-job traces;
+    each conforms on its own even though the merged order would not."""
+    log = EventLog()
+    log.emit("job_admitted", job=1, tenant="a")
+    log.emit("job_admitted", job=2, tenant="b")
+    log.emit("job_dequeued", job=2, tenant="b")
+    log.emit("job_dequeued", job=1, tenant="a")
+    log.emit("job_done", job=2)
+    log.emit("job_done", job=1)
+    report = assert_conformant(log)
+    assert report["contracts"]["job_lifecycle"]["checked"] == 2
+
+
+def test_conformance_when_gates_agent_side_journals():
+    """A journal that never admits (an agent-side trace) is not held to
+    the admission prefix: zero traces checked, still ok."""
+    log = EventLog()
+    log.emit("job_done", job=1)
+    report = conformance_report([e.to_dict() for e in log.events()])
+    assert report["ok"]
+    assert report["contracts"]["job_lifecycle"]["checked"] == 0
+
+
+def test_violation_names_contract_and_shows_trace():
+    log = EventLog()
+    _lifecycle(log, job=1)
+    log.emit("job_done", job=1)  # double finish: illegal second terminal
+    report = conformance_report(log)
+    assert not report["ok"]
+    v = report["violations"][0]
+    assert v["contract"] == "job_lifecycle"
+    assert v["scope"]["job"] == 1
+    text = format_conformance(report)
+    assert "VIOLATED job_lifecycle" in text
+    with pytest.raises(AssertionError, match="job_lifecycle"):
+        assert_conformant(log)
+
+
+def test_tampered_real_drill_journal_names_contract(devices, tmp_path):
+    """Satellite: a REAL eviction-drill journal replays conformant; the
+    same journal with one `job_dequeued` deleted is flagged, naming the
+    violated contract."""
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.scheduler import FaultInjector
+    from dsort_tpu.serve import SortService
+
+    inj = FaultInjector()
+    journal = EventLog()
+    svc = SortService(job=JobConfig(settle_delay_s=0.01,
+                                    flight_recorder_dir=str(tmp_path)),
+                      injector=inj, journal=journal, start=False)
+    inj.fail_once(0, "slice")
+    rng = np.random.default_rng(17)
+    d = rng.integers(0, 1 << 30, 5000, dtype=np.int32)
+    v, t = svc.submit(d, tenant="acme")
+    assert v.admitted
+    svc.start()
+    np.testing.assert_array_equal(t.result(timeout=300), np.sort(d))
+    svc.shutdown(drain=True)
+    records = [e.to_dict() for e in journal.events()]
+    assert_conformant(records)  # the real artifact is conformant
+    assert any(r["type"] == "job_evicted" for r in records)
+    # Tamper: drop the FIRST dequeue — the trace now shows an attempt
+    # that was never dequeued.
+    cut = next(i for i, r in enumerate(records)
+               if r["type"] == "job_dequeued")
+    tampered = records[:cut] + records[cut + 1:]
+    report = conformance_report(tampered)
+    assert not report["ok"]
+    assert report["violations"][0]["contract"] == "job_lifecycle"
+
+
+def test_analyzer_conformance_verdict_key():
+    """`obs.analyze` carries the conformance report as a first-class
+    verdict key, None on an empty journal."""
+    from dsort_tpu.obs.analyze import VERDICT_KEYS, analyze_records
+
+    assert "conformance" in VERDICT_KEYS
+    log = EventLog()
+    _lifecycle(log, job=1)
+    verdict = analyze_records([e.to_dict() for e in log.events()])
+    assert verdict["conformance"]["ok"] is True
+    assert analyze_records([])["conformance"] is None
+
+
+def test_cli_report_conform_exit_codes(tmp_path, capsys):
+    """`dsort report --conform` exits 0 on a conformant journal, 1 on a
+    tampered one, and names the violated contract."""
+    from dsort_tpu import cli
+
+    log = EventLog()
+    _lifecycle(log, job=1, evict=True)
+    good = tmp_path / "good.jsonl"
+    log.write_jsonl(str(good))
+    assert cli.main(["report", str(good), "--conform"]) == 0
+    assert "OK" in capsys.readouterr().out
+    bad = tmp_path / "bad.jsonl"
+    records = [json.loads(x) for x in good.read_text().splitlines()]
+    bad.write_text("\n".join(
+        json.dumps(r) for r in records if r["type"] != "job_dequeued"
+    ) + "\n")
+    assert cli.main(["report", str(bad), "--conform"]) == 1
+    assert "job_lifecycle" in capsys.readouterr().out
+
+
+# -- the model checker -------------------------------------------------------
+
+
+def test_model_smoke_bound_is_clean():
+    """THE acceptance gate: >= 10,000 distinct states at the smoke bound,
+    zero invariant violations on the real (unseamed) protocol."""
+    res = check_model(ModelConfig(), seams=(), max_states=12_000)
+    assert res.ok, res.violation
+    assert res.states >= 10_000
+
+
+def test_model_small_bound_exhausts_clean():
+    """A tiny configuration (1 agent, 1 job, no failures) exhausts its
+    whole state space — truncation-free — with no violation."""
+    cfg = ModelConfig(n_agents=1, n_jobs=1, max_duplications=0,
+                      max_deaths=0, max_reattaches=0, max_crashes=0,
+                      max_requeues=1)
+    res = check_model(cfg, seams=(), max_states=100_000)
+    assert res.ok and not res.truncated
+    assert res.states > 50
+
+
+@pytest.mark.parametrize("seam", SEAMS)
+def test_seeded_mutation_is_caught(seam):
+    """Mutation self-test: each re-introduced PR-12 bug (ack-before-
+    persist ordering, non-atomic duplicate-jid reservation) must yield a
+    violating schedule, and the minimized schedule must replay to the
+    SAME invariant deterministically."""
+    res = check_model(ModelConfig(), seams=(seam,), max_states=20_000)
+    assert not res.ok, f"seam {seam} not caught"
+    v = res.violation
+    assert v.schedule, "violation must carry a replayable schedule"
+    replayed = replay_schedule(v.schedule, ModelConfig(), (seam,))
+    assert replayed is not None and replayed.invariant == v.invariant
+    # Deterministic: a second replay reproduces bit-for-bit.
+    again = replay_schedule(v.schedule, ModelConfig(), (seam,))
+    assert again.invariant == replayed.invariant
+    assert again.detail == replayed.detail
+
+
+@pytest.mark.parametrize("seam", SEAMS)
+def test_committed_fixture_replays(seam):
+    """The committed minimized fixtures reproduce their recorded
+    invariant — the schedule-fixture replay contract of §16."""
+    path = os.path.join(FIXTURES, f"{seam}.json")
+    with open(path, encoding="utf-8") as f:
+        recorded = json.load(f)
+    schedule, cfg, seams = load_fixture(path)
+    assert seams == (seam,)
+    v = replay_schedule(schedule, cfg, seams)
+    assert v is not None
+    assert v.invariant == recorded["invariant"]
+
+
+def test_unseamed_replay_of_fixture_schedules_is_clean():
+    """The SAME schedules on the REAL protocol (no seam) violate
+    nothing: the fixtures isolate the seeded bug, not model noise."""
+    for seam in SEAMS:
+        schedule, cfg, _ = load_fixture(
+            os.path.join(FIXTURES, f"{seam}.json")
+        )
+        try:
+            v = replay_schedule(schedule, cfg, ())
+        except ValueError:
+            continue  # a seam-only action (e.g. reserve) is not enabled
+        assert v is None
+
+
+def test_cli_spec_check_and_replay(tmp_path, capsys):
+    from dsort_tpu import cli
+
+    assert cli.main(["spec", "check", "--max-states", "500"]) == 0
+    assert "OK" in capsys.readouterr().out
+    fix = tmp_path / "v.json"
+    rc = cli.main(["spec", "check", "--seam", "ack_before_persist",
+                   "--max-states", "5000", "--dump-fixture", str(fix)])
+    assert rc == 1 and fix.exists()
+    capsys.readouterr()
+    assert cli.main(["spec", "replay", "--fixture", str(fix)]) == 0
+    assert "reproduces" in capsys.readouterr().out
+
+
+# -- frame-decoder fuzz ------------------------------------------------------
+
+
+class _CaptureSock:
+    def __init__(self):
+        self.data = bytearray()
+
+    def sendall(self, b):
+        self.data.extend(b)
+
+
+class _ByteSock:
+    """A byte-buffer socket that fails the test if the decoder stops
+    making progress (the never-hang half of the contract)."""
+
+    def __init__(self, data: bytes):
+        self._data = bytes(data)
+        self._pos = 0
+        self.calls = 0
+
+    def recv(self, n):
+        self.calls += 1
+        assert self.calls < 10_000, "decoder looped without progress"
+        chunk = self._data[self._pos:self._pos + n]
+        self._pos += len(chunk)
+        return chunk
+
+
+def _valid_frames() -> dict[str, bytes]:
+    """One well-formed wire frame per registered type."""
+    out = {}
+    for ftype in proto.FRAME_TYPES:
+        sock = _CaptureSock()
+        header = {"type": ftype, "job_id": "j1", "tenant": "t"}
+        payload = b""
+        if ftype in ("submit", "result"):
+            meta, payload = proto.encode_array(
+                np.arange(16, dtype=np.int32)
+            )
+            header.update(meta)
+            header["ok"] = True
+        proto.send_frame(sock, header, payload)
+        out[ftype] = bytes(sock.data)
+    return out
+
+
+def _decode_all(data: bytes):
+    """Drive recv_frame (and the array decoder, where meta rides the
+    header) over a byte stream until EOF; ProtocolError is the typed,
+    expected outcome for corrupt input."""
+    sock = _ByteSock(data)
+    while True:
+        frame = proto.recv_frame(sock)
+        if frame is None:
+            return
+        header, payload = frame
+        if "dtype" in header and "shape" in header:
+            try:
+                proto.decode_array(header, payload)
+            except proto.ProtocolError:
+                pass
+
+
+def _mutate(data: bytes, rng: random.Random) -> bytes:
+    buf = bytearray(data)
+    op = rng.randrange(4)
+    if op == 0:  # flip 1-4 bytes anywhere (length prefix included)
+        for _ in range(rng.randint(1, 4)):
+            i = rng.randrange(len(buf))
+            buf[i] ^= 1 << rng.randrange(8)
+    elif op == 1:  # truncate mid-frame
+        del buf[rng.randrange(1, len(buf)):]
+    elif op == 2:  # duplicate a slice (reordered/garbled tail)
+        i = rng.randrange(len(buf))
+        buf.extend(buf[i:i + rng.randint(1, 32)])
+    else:  # prepend a random prefix (stray client)
+        buf[:0] = bytes(rng.randrange(256) for _ in range(rng.randint(1, 8)))
+    return bytes(buf)
+
+
+def _persist_fuzz_fixture(seed, ftype, data, exc):
+    path = os.path.join(FIXTURES, f"fuzz_{seed}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"seed": seed, "frame_type": ftype,
+                   "error": repr(exc), "data_hex": data.hex()}, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def test_frame_decoder_fuzz_typed_errors_only():
+    """Seeded byte mutations of every registered frame either parse or
+    raise `ProtocolError` — no hangs, no foreign exceptions.  A failing
+    seed persists as a regression fixture next to the minimized
+    schedules before the test fails."""
+    frames = _valid_frames()
+    assert set(frames) == set(proto.FRAME_TYPES)
+    for ftype, data in frames.items():  # the unmutated baseline parses
+        _decode_all(data)
+    types = sorted(frames)
+    for seed in range(300):
+        rng = random.Random(seed)
+        ftype = types[seed % len(types)]
+        mutated = _mutate(frames[ftype], rng)
+        try:
+            _decode_all(mutated)
+        except proto.ProtocolError:
+            pass
+        except Exception as e:  # noqa: BLE001 - the property under test
+            path = _persist_fuzz_fixture(seed, ftype, mutated, e)
+            raise AssertionError(
+                f"seed {seed} ({ftype}): {e!r} is not a ProtocolError; "
+                f"fixture persisted at {path}"
+            ) from e
+
+
+def test_frame_fuzz_regression_fixtures_replay():
+    """Any persisted failing seed stays fixed: replay every committed
+    fuzz fixture and require typed behavior."""
+    import glob
+
+    for path in sorted(glob.glob(os.path.join(FIXTURES, "fuzz_*.json"))):
+        with open(path, encoding="utf-8") as f:
+            fix = json.load(f)
+        try:
+            _decode_all(bytes.fromhex(fix["data_hex"]))
+        except proto.ProtocolError:
+            pass
+
+
+def test_frame_decoder_never_buffers_past_header_bound():
+    """A corrupt length prefix claiming a >1 MB header raises BEFORE any
+    header bytes are consumed — the no-over-allocation bound."""
+    sock = _ByteSock(struct.pack(">I", proto.MAX_HEADER_BYTES + 1) + b"x" * 64)
+    with pytest.raises(proto.ProtocolError, match="implausible"):
+        proto.recv_frame(sock)
+    assert sock._pos == 4  # only the prefix was read
+    # A valid header claiming an over-bound payload is equally typed.
+    head = json.dumps({"type": "ping",
+                       "payload_len": proto.MAX_FRAME_BYTES + 1}).encode()
+    sock = _ByteSock(struct.pack(">I", len(head)) + head)
+    with pytest.raises(proto.ProtocolError, match="implausible"):
+        proto.recv_frame(sock)
+
+
+def test_decode_array_rejects_malformed_meta():
+    _, payload = proto.encode_array(np.arange(8, dtype=np.int64))
+    for meta in (
+        {"dtype": "not-a-dtype", "shape": [8]},
+        {"dtype": "int64", "shape": ["x"]},
+        {"dtype": "int64"},
+        {"dtype": "int64", "shape": [-1]},
+        {"dtype": "int64", "shape": [4]},
+    ):
+        with pytest.raises(proto.ProtocolError):
+            proto.decode_array(meta, payload)
+
+
+# -- DS10xx: seeded spec<->handler drift -------------------------------------
+
+
+def _copy_tree(tmp_path):
+    root = tmp_path / "repo"
+    shutil.copytree(
+        os.path.join(REPO, "dsort_tpu"), root / "dsort_tpu",
+        ignore=shutil.ignore_patterns("__pycache__", "*.so", "*.o"),
+    )
+    shutil.copy(os.path.join(REPO, "pyproject.toml"), root / "pyproject.toml")
+    return root
+
+
+def test_seeded_handler_drift_is_caught(tmp_path):
+    """Acceptance: delete one handler arm (the agent's `drain`) in a
+    copied tree — DS1003 names the frame whose declared transition lost
+    its code path."""
+    root = _copy_tree(tmp_path)
+    agent = root / "dsort_tpu" / "fleet" / "agent.py"
+    src = agent.read_text()
+    assert 'elif ftype == "drain":' in src
+    agent.write_text(src.replace('elif ftype == "drain":',
+                                 'elif ftype == "bye":', 1))
+    diags = lint_paths([str(root / "dsort_tpu" / "fleet")],
+                       load_config(str(root)))
+    hits = [d for d in diags if d.code == "DS1003"]
+    assert hits, [d.format() for d in diags]
+    assert any("drain" in d.message for d in hits)
+
+
+def test_real_tree_is_spec_clean():
+    """The shipped tree has zero DS10xx/DS11xx findings — the checker
+    gates drift, it does not start life with a baseline."""
+    diags = lint_paths([os.path.join(REPO, "dsort_tpu")], load_config(REPO))
+    spec_codes = [d for d in diags if d.code.startswith("DS1")
+                  and len(d.code) == 6]
+    assert spec_codes == [], [d.format() for d in spec_codes]
+
+
+def test_no_hand_rolled_sequence_literals_in_tests():
+    """Acceptance: the contract engine SERVES the sequence asserts — the
+    test tree itself carries no duplicated in-alphabet sequence literals
+    (DS1103 over tests/)."""
+    diags = lint_paths([os.path.join(REPO, "tests")], load_config(REPO))
+    hits = [d for d in diags if d.code == "DS1103"]
+    assert hits == [], [d.format() for d in hits]
+
+
+def test_lint_cache_key_tracks_spec_sources(tmp_path):
+    """Satellite: editing a spec source invalidates the lint cache —
+    the registry paths participate in the config key."""
+    (tmp_path / "machines.py").write_text("PROTOCOL_SPEC = {}\n")
+    (tmp_path / "contracts.py").write_text("TRACE_CONTRACTS = {}\n")
+    cfg = LintConfig(root=str(tmp_path), spec_registry_path="machines.py",
+                     contracts_registry_path="contracts.py")
+    checkers = all_checkers()
+    k1 = ResultCache._config_key(cfg, checkers)
+    (tmp_path / "contracts.py").write_text("TRACE_CONTRACTS = {'x': {}}\n")
+    k2 = ResultCache._config_key(cfg, checkers)
+    assert k1 != k2
+
+
+# -- ARCHITECTURE §16 schema enforcement -------------------------------------
+
+
+def test_architecture_documents_spec_plane():
+    """§16's contract is test-enforced like §7-§15: the invariant
+    catalog appears VERBATIM, every contract and machine is named, and
+    the fixture-replay contract is documented."""
+    from dsort_tpu.analysis.spec.machines import SPEC_INVARIANTS
+
+    arch = open(os.path.join(REPO, "ARCHITECTURE.md"),
+                encoding="utf-8").read()
+    assert "## 16. Spec plane" in arch
+    for name, text in SPEC_INVARIANTS.items():
+        assert f"`{name}`" in arch, f"invariant {name} undocumented"
+        assert text in arch, f"invariant {name} text not verbatim"
+    for machine in PROTOCOL_SPEC:
+        assert f"`{machine}`" in arch, f"machine {machine} undocumented"
+    for contract in TRACE_CONTRACTS:
+        assert f"`{contract}`" in arch, f"contract {contract} undocumented"
+    for phrase in ("spec-smoke", "replay", "minimized", "--conform"):
+        assert phrase in arch
+    for code in ("DS1001", "DS1002", "DS1003", "DS1004", "DS1005",
+                 "DS1101", "DS1102", "DS1103"):
+        assert code in arch, f"{code} missing from the checker catalog"
