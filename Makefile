@@ -60,6 +60,11 @@ autotune-smoke:  ## closed-loop planner A/B: hand-set alltoall/ring vs planner-c
 	$(PY) -m dsort_tpu.cli bench --autotune-ab --n 131072 --reps 1 \
 	--journal /tmp/dsort_autotune_smoke.jsonl
 
+hier-smoke:  ## two-level pod exchange A/B: flat ring vs hier at simulated HxD topologies + device/host-loss drills, bit-identical + DCN-reduction gate (8-device cpu mesh)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m dsort_tpu.cli bench --hier-ab --n 131072 --reps 1 \
+	--journal /tmp/dsort_hier_smoke.jsonl
+
 # Regression diff over versioned bench artifacts (tolerance ladder:
 # ok >= 0.95 > noise >= 0.80 > regression >= 0.50 > severe); exits 1 on
 # severe (STRICT=1: also on regression).  Backend-free.
@@ -88,4 +93,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke spec-smoke profile-smoke external-smoke coded-smoke autotune-smoke bench-compare bench-history native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke spec-smoke profile-smoke external-smoke coded-smoke autotune-smoke hier-smoke bench-compare bench-history native tsan asan ubsan sanitize

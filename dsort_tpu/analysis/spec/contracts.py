@@ -91,6 +91,15 @@ TRACE_CONTRACTS = {
         "when": ("wave_resume",),
         "steps": ("wave_resume+ ( job_done | job_failed )?",),
     },
+    # The §17 two-level fault contract: a hier host-grouping re-plan is
+    # journaled only as part of a mesh re-form — the device deaths and
+    # the survivor count precede it (a hang-reap re-form may carry no
+    # worker_dead), never free-standing, at most one per re-form.
+    "hier_reform": {
+        "scope": (),
+        "when": ("hier_reform",),
+        "steps": ("( worker_dead* mesh_reform hier_reform? )+",),
+    },
 }
 
 #: Event types legitimately OUTSIDE any trace contract (telemetry,
@@ -136,6 +145,15 @@ CONTRACT_EXEMPT = (
     "coded_budget_exceeded",
     "plan_decision",
     "plan_override",
+    # §17 planning telemetry: per-exchange sizing snapshots with no
+    # ordering obligation (the fault-path twin, hier_reform, IS
+    # contract-covered above).
+    "hier_exchange_plan",
+    "hier_exchange_leg",
+    # A per-dispatch latency sample (the dispatch_timeout_s policy's
+    # measured input): the accept reply and the result race on separate
+    # threads, so this marker carries no ordering obligation.
+    "job_dispatched",
 )
 
 _TOKEN = re.compile(r"[A-Za-z_][A-Za-z0-9_]*|[()|?*+]|\s+")

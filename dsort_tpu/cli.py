@@ -93,6 +93,8 @@ def _load_config(args) -> SortConfig:
         job_over["merge_kernel"] = args.merge_kernel
     if getattr(args, "exchange", None):
         job_over["exchange"] = args.exchange
+    if getattr(args, "hier_hosts", None):
+        job_over["hier_hosts"] = args.hier_hosts
     if getattr(args, "redundancy", None):
         job_over["redundancy"] = args.redundancy
     if getattr(args, "checkpoint_dir", None):
@@ -1381,6 +1383,190 @@ def _bench_coded_ab(args, cfg: SortConfig) -> int:
     return 0 if ok_all else 1
 
 
+def _bench_hier_ab(args, cfg: SortConfig) -> int:
+    """`dsort bench --hier-ab`: the pod-scale two-level exchange A/B.
+
+    The `make hier-smoke` target (tier-1-gated) and THE acceptance harness
+    for the hierarchical exchange plane (ARCHITECTURE §17): one zipf
+    workload sorted flat-ring and two-level at every simulated ``H x D``
+    topology the local mesh divides into, then the fault drills.  Gates
+    (ok -> exit 0):
+
+    - every arm's output bit-identical to ``np.sort`` (the schedule may
+      only change HOW keys move, never WHAT comes back);
+    - at every topology the journaled ``dcn_bytes_on_wire`` is LESS than
+      what the flat ring would have pushed across the same host boundary
+      for the same measured histogram (``ring_dcn_bytes``; the
+      ``dcn_bytes_saved`` counter is exactly the difference) — the
+      tentpole claim, measured, not asserted;
+    - the DEVICE-loss drill re-forms within the host: losing devices of
+      one host mid-exchange keeps the ``H``-host grouping (journaled
+      ``hier_reform`` with ``hosts_before == hosts_after``) and returns
+      bit-identical output;
+    - the HOST-loss drill re-plans: losing ALL of one host's devices
+      mid-phase-two re-forms the survivors under the largest divisor the
+      mesh still supports (``hier_reform`` with ``hosts_after <
+      hosts_before``) and returns bit-identical output.
+
+    One JSON row per topology (throughputs + the DCN/intra wire split)
+    plus one row per drill.
+    """
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.ingest import gen_zipf
+    from dsort_tpu.parallel.mesh import local_device_mesh
+    from dsort_tpu.parallel.sample_sort import SampleSort
+    from dsort_tpu.scheduler import FaultInjector, SpmdScheduler
+    from dsort_tpu.utils.events import EventLog
+
+    mesh = local_device_mesh(cfg.mesh.num_workers)
+    p = int(mesh.shape["w"])
+    if p < 4:
+        raise SystemExit(
+            "--hier-ab needs >= 4 devices (two simulated hosts of two "
+            "devices is the smallest two-level topology); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    journal = _open_journal(args) or EventLog()
+    data = gen_zipf(args.n, a=1.3, seed=6)
+    expect = np.sort(data)
+    n = len(data)
+    # Every >=2-host grouping with >=2 devices per host the mesh divides
+    # into — on the canonical 8-device mesh: 2x4 and 4x2.
+    topologies = [h for h in (2, 4, 8) if h < p and p % h == 0 and p // h >= 2]
+    job_kw = dict(key_dtype=np.int64, local_kernel=cfg.job.local_kernel)
+    ok_all = True
+    try:
+        ss_ring = SampleSort(mesh, JobConfig(exchange="ring", **job_kw))
+        ss_ring.sort(data)  # warm/compile
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            ring_out = ss_ring.sort(data)
+            times.append(time.perf_counter() - t0)
+        ring_dt = float(min(times))
+        for hosts in topologies:
+            ss = SampleSort(
+                mesh, JobConfig(exchange="hier", hier_hosts=hosts, **job_kw)
+            )
+            ss.sort(data)  # warm/compile
+            m = Metrics(journal=journal)
+            times = []
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                out = ss.sort(data, metrics=m)
+                times.append(time.perf_counter() - t0)
+            dt = float(min(times))
+            identical = bool(np.array_equal(out, expect)) and bool(
+                np.array_equal(ring_out, expect)
+            )
+            dcn = m.counters.get("dcn_bytes_on_wire", 0) // args.reps
+            intra = m.counters.get("intra_host_bytes_on_wire", 0) // args.reps
+            saved = m.counters.get("dcn_bytes_saved", 0) // args.reps
+            ring_dcn = dcn + saved  # the flat baseline, same histogram
+            reduced = saved > 0
+            ok = identical and reduced
+            ok_all = ok_all and ok
+            print(json.dumps({
+                "metric": f"hier_exchange_zipf_{args.n}_h{hosts}",
+                "value": round(n / dt, 1),
+                "unit": "keys/sec",
+                "hosts": hosts,
+                "dev_per_host": p // hosts,
+                "ring_keys_per_sec": round(n / ring_dt, 1),
+                "dcn_bytes": int(dcn),
+                "ring_dcn_bytes": int(ring_dcn),
+                "dcn_reduction_frac": round(saved / ring_dcn, 4)
+                if ring_dcn else 0.0,
+                "intra_host_bytes": int(intra),
+                "hier_exchanges": m.counters.get("hier_exchanges", 0)
+                // args.reps,
+                "bit_identical": identical,
+            }), flush=True)
+
+        # The fault drills: the hook fires between the (H, H) plan and the
+        # exchange dispatch (the schedule is sized, the legs are "in
+        # flight"), so a tripped loss invalidates the planned exchange and
+        # the survivors re-plan — the two-level fault contract, measured.
+        def drill(hosts: int, victims: list[int]):
+            inj = FaultInjector()
+            sched = SpmdScheduler(
+                devices=list(mesh.devices.flat),
+                job=JobConfig(
+                    settle_delay_s=0.01, exchange="hier", hier_hosts=hosts,
+                    **job_kw,
+                ),
+                injector=inj,
+            )
+            sched.sort(data)  # healthy warm pass, off the clock
+            for w in victims:
+                inj.fail_once(w, "ring")
+            m = Metrics(journal=journal)
+            t0 = time.perf_counter()
+            out = sched.sort(data, metrics=m)
+            dt = time.perf_counter() - t0
+            reforms = [
+                e for e in journal.events()
+                if e.type == "hier_reform"
+            ][-1:]
+            rf = reforms[0].fields if reforms else {}
+            identical = bool(np.array_equal(out, expect))
+            return dt, m, rf, identical
+
+        # Device loss, H=2: losing devices of host 0 (never the whole
+        # host) re-forms WITHIN the host — the 2-host grouping survives.
+        # Victims are chosen so the survivor count still divides by 2 (the
+        # 1-D simulation has no fixed per-host slot map, so an odd
+        # survivor count would force a downgrade a real pod's re-formed
+        # host group would not).
+        dev_victims = [1] if (p - 1) % 2 == 0 else [1, 2]
+        dt_dev, m_dev, rf_dev, id_dev = drill(2, dev_victims)
+        ok_dev = (
+            id_dev and rf_dev.get("hosts_before") == 2
+            and rf_dev.get("hosts_after") == 2
+            and not rf_dev.get("downgraded")
+        )
+        ok_all = ok_all and ok_dev
+        print(json.dumps({
+            "metric": f"hier_device_loss_drill_zipf_{args.n}",
+            "value": round(n / dt_dev, 1),
+            "unit": "keys/sec",
+            "hosts_before": rf_dev.get("hosts_before"),
+            "hosts_after": rf_dev.get("hosts_after"),
+            "downgraded": rf_dev.get("downgraded"),
+            "survivors": rf_dev.get("survivors"),
+            "mesh_reforms": m_dev.counters.get("mesh_reforms", 0),
+            "bit_identical": id_dev,
+        }), flush=True)
+        # Host loss, H=4 (when the mesh supports it): ALL of host 1's
+        # devices die mid-phase-two; the survivors no longer divide by 4,
+        # so the re-plan lands on the largest divisor they do support.
+        if 4 in topologies:
+            dh = p // 4
+            host_victims = list(range(dh, 2 * dh))
+            dt_host, m_host, rf_host, id_host = drill(4, host_victims)
+            ok_host = (
+                id_host and rf_host.get("hosts_before") == 4
+                and 2 <= int(rf_host.get("hosts_after") or 0) < 4
+                and not rf_host.get("downgraded")
+            )
+            ok_all = ok_all and ok_host
+            print(json.dumps({
+                "metric": f"hier_host_loss_drill_zipf_{args.n}",
+                "value": round(n / dt_host, 1),
+                "unit": "keys/sec",
+                "hosts_before": rf_host.get("hosts_before"),
+                "hosts_after": rf_host.get("hosts_after"),
+                "downgraded": rf_host.get("downgraded"),
+                "survivors": rf_host.get("survivors"),
+                "mesh_reforms": m_host.counters.get("mesh_reforms", 0),
+                "bit_identical": id_host,
+            }), flush=True)
+    finally:
+        if getattr(args, "journal", None):
+            journal.flush_jsonl(args.journal)
+    return 0 if ok_all else 1
+
+
 def _bench_autotune_ab(args, cfg: SortConfig) -> int:
     """`dsort bench --autotune-ab`: does the planner pay for itself?
 
@@ -2082,6 +2268,21 @@ def cmd_bench(args) -> int:
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if getattr(args, "hier_ab", False):
+        if args.suite or getattr(args, "device_resident", False) or getattr(
+            args, "exchange_ab", False
+        ) or getattr(args, "serve_mixed", False) or getattr(
+            args, "analyze_smoke", False
+        ) or getattr(args, "external_wave", False) or getattr(
+            args, "fleet_mixed", False
+        ) or getattr(args, "coded_ab", False) or getattr(
+            args, "autotune_ab", False
+        ):
+            raise SystemExit(
+                "--hier-ab is its own benchmark: run it as a separate "
+                "invocation"
+            )
+        return _bench_hier_ab(args, _load_config(args))
     if getattr(args, "autotune_ab", False):
         if args.suite or getattr(args, "device_resident", False) or getattr(
             args, "exchange_ab", False
@@ -2328,6 +2529,16 @@ def cmd_terasort(args) -> int:
     from dsort_tpu.parallel.sample_sort import SampleSort
     from dsort_tpu.config import JobConfig
 
+    # The exchange knob, conf-key parity with `dsort run`/`dsort external`:
+    # an explicit --exchange flag wins, then a conf EXCHANGE key, then the
+    # JobConfig default (same precedence ladder as _load_config).
+    conf_job = SortConfig.from_conf_file(args.conf).job if args.conf else None
+    exchange = getattr(args, "exchange", None) or (
+        conf_job.exchange if conf_job else None
+    )
+    hier_hosts = getattr(args, "hier_hosts", None) or (
+        conf_job.hier_hosts if conf_job else 0
+    )
     if args.external:
         from dsort_tpu.models.external_sort import ExternalTeraSort
 
@@ -2351,6 +2562,8 @@ def cmd_terasort(args) -> int:
                     spill_dir=args.spill_dir,
                     job_id=args.job_id,
                     resume=not args.no_resume,
+                    job=conf_job,
+                    exchange=getattr(args, "exchange", None),
                 )
             else:
                 if args.workers is not None:
@@ -2359,6 +2572,12 @@ def cmd_terasort(args) -> int:
                         "to make run generation mesh-parallel (without it, "
                         "external run generation is single-device and only "
                         "the merge parallelizes over host cores)"
+                    )
+                if exchange:
+                    log.warning(
+                        "--exchange has no effect without --mesh: the "
+                        "single-device external record sort has no "
+                        "exchange; add --mesh N to run record waves"
                     )
                 s = ExternalTeraSort(
                     run_recs=args.run_recs,
@@ -2384,11 +2603,16 @@ def cmd_terasort(args) -> int:
 
     keys, payload = read_terasort_file(args.input)
     mesh = local_device_mesh(args.workers)
-    job = JobConfig(key_dtype=np.uint64, payload_bytes=payload.shape[1])
+    job = JobConfig(
+        key_dtype=np.uint64, payload_bytes=payload.shape[1],
+        exchange=exchange or JobConfig.exchange,
+        hier_hosts=hier_hosts or JobConfig.hier_hosts,
+    )
     metrics = Metrics()
     t0 = time.perf_counter()
     sk, sv = SampleSort(mesh, job).sort_kv(
-        keys, payload, metrics=metrics, secondary=terasort_secondary(payload)
+        keys, payload, metrics=metrics, secondary=terasort_secondary(payload),
+        exchange=getattr(args, "exchange", None),
     )
     dt = time.perf_counter() - t0
     write_terasort_file(args.output or "terasort_out.bin", sk, sv)
@@ -2433,12 +2657,17 @@ def cmd_external(args) -> int:
 
             from dsort_tpu.config import JobConfig
 
+            job_kw = {}
+            if args.kernel:
+                job_kw["local_kernel"] = args.kernel
+            if getattr(args, "hier_hosts", None):
+                job_kw["hier_hosts"] = args.hier_hosts
             s = ExternalWaveSort(
                 mesh=local_device_mesh(mesh_n),
                 wave_elems=wave_elems,
                 spill_dir=args.spill_dir,
                 job_id=args.job_id,
-                job=JobConfig(local_kernel=args.kernel) if args.kernel else None,
+                job=JobConfig(**job_kw) if job_kw else None,
                 resume=not args.no_resume,
                 overlap=not getattr(args, "no_overlap", False),
                 exchange=getattr(args, "exchange", None),
@@ -2902,13 +3131,22 @@ def main(argv=None) -> int:
                        choices=["auto", "sort", "bitonic", "block_merge"],
                        help="post-shuffle combine (default auto: block_merge "
                             "wherever the block kernel applies)")
-        p.add_argument("--exchange", choices=["alltoall", "ring", "fused"],
+        p.add_argument("--exchange",
+                       choices=["alltoall", "ring", "fused", "hier"],
                        help="bucket exchange schedule (default alltoall; "
                             "ring = chunked ppermute with adaptive per-step "
                             "headroom and merge-as-you-receive; fused = the "
                             "same measured ring schedule as ONE Pallas "
                             "kernel — in-kernel async remote DMAs, P-1 "
-                            "dispatches collapsed to one launch)")
+                            "dispatches collapsed to one launch; hier = the "
+                            "two-level pod schedule: intra-host aggregation "
+                            "then ONE merged DCN transfer per host pair, "
+                            "ARCHITECTURE §17)")
+        p.add_argument("--hier-hosts", type=int,
+                       help="host count the hier schedule groups the worker "
+                            "mesh into (default 0 = auto: the process count "
+                            "when genuinely multi-host, else 2 simulated; "
+                            "conf key HIER_HOSTS)")
         p.add_argument("--redundancy", type=int,
                        help="coded redundancy r (default 1 = off): the ring "
                             "exchange additionally ships every bucket to "
@@ -3122,6 +3360,12 @@ def main(argv=None) -> int:
                         "unset); gates bit-identical outputs, the measured-"
                         "skew pick (ring on zipf, alltoall on uniform) and "
                         "autotune >= 0.95x the best hand-set arm at 1M+")
+    p.add_argument("--hier-ab", action="store_true",
+                   help="two-level pod exchange A/B: flat ring vs hier at "
+                        "every simulated HxD topology the mesh divides "
+                        "into, plus the device-loss and host-loss drills "
+                        "(bit-identical gate; gates measured DCN-leg byte "
+                        "reduction and the survivors' (H',H') re-plan)")
     p.add_argument("--external-wave", action="store_true",
                    help="out-of-core wave-pipeline benchmark: sort a "
                         "dataset 8x the per-wave device budget through the "
@@ -3163,6 +3407,16 @@ def main(argv=None) -> int:
                         "devices (the wave pipeline; conf EXTERNAL_MESH)")
     p.add_argument("--run-recs", type=int, default=1 << 20,
                    help="records per spilled run / per wave (external mode)")
+    p.add_argument("--exchange",
+                   choices=["alltoall", "ring", "fused", "hier"],
+                   help="bucket exchange schedule (conf key EXCHANGE; flag "
+                        "wins).  In-core record sorts route it through the "
+                        "kv exchange plane; external record waves run the "
+                        "host-side exchange, where mesh schedules warn and "
+                        "the knob is validated for conf parity")
+    p.add_argument("--hier-hosts", type=int,
+                   help="host grouping for --exchange hier (default 0 = "
+                        "auto; conf HIER_HOSTS)")
     p.add_argument("--spill-dir")
     p.add_argument("--job-id", default="tera_external")
     p.add_argument("--no-resume", action="store_true",
@@ -3196,10 +3450,15 @@ def main(argv=None) -> int:
     p.add_argument("--no-overlap", action="store_true",
                    help="disable the wave pipeline's spill/exchange overlap "
                         "(the A/B baseline)")
-    p.add_argument("--exchange", choices=["ring", "fused"],
+    p.add_argument("--exchange", choices=["ring", "fused", "hier"],
                    help="per-wave exchange schedule (wave mode; default "
                         "ring; fused = exchange+merge as one Pallas kernel "
-                        "per wave)")
+                        "per wave; hier = the two-level pod schedule — "
+                        "each wave aggregates per destination HOST before "
+                        "the DCN leg, ARCHITECTURE §17)")
+    p.add_argument("--hier-hosts", type=int,
+                   help="host grouping for --exchange hier (default 0 = "
+                        "auto; conf HIER_HOSTS)")
     p.add_argument("--redundancy", type=int,
                    help="coded redundancy r for each wave's exchange "
                         "(default 1 = off): a device lost mid-wave repairs "

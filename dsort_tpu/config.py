@@ -103,8 +103,19 @@ class JobConfig:
     # (`parallel.exchange`) — bit-identical output, adaptive headroom;
     # "fused" = the same measured-capacity ring schedule run as ONE Pallas
     # kernel (`ops.ring_kernel`): per-step async remote DMAs with the merge
-    # folded between them, one launch instead of P-1 dispatches.
+    # folded between them, one launch instead of P-1 dispatches;
+    # "hier" = the two-level pod schedule (ARCHITECTURE §17): intra-host
+    # aggregation ring, then ONE merged transfer per (src-host, dst-host)
+    # pair over the DCN leg, then a local scatter — DCN bytes sized from
+    # the (H, H) host matrix instead of scaling with P.
     exchange: str = "alltoall"
+    # Host count the hier schedule groups the 1-D worker mesh into.
+    # 0 = auto: `jax.process_count()` when genuinely multi-host, else 2
+    # hosts simulated (`parallel.exchange.resolve_hier_hosts`); a value
+    # that doesn't divide the worker count resolves to the nearest
+    # divisor below it, and meshes under 4 workers downgrade to the flat
+    # ring with a warning.
+    hier_hosts: int = 0
     # Coded redundancy (ARCHITECTURE §14, arXiv:1702.04850): r-way bucket
     # replication across ring successors DURING the exchange, so up to r-1
     # device losses recover by a local merge of replica slots instead of a
@@ -195,10 +206,14 @@ class JobConfig:
                 "merge_kernel must be 'auto', 'sort', 'bitonic' or "
                 f"'block_merge', got {self.merge_kernel!r}"
             )
-        if self.exchange not in ("alltoall", "ring", "fused"):
+        if self.exchange not in ("alltoall", "ring", "fused", "hier"):
             raise ConfigError(
-                "exchange must be 'alltoall', 'ring' or 'fused', got "
-                f"{self.exchange!r}"
+                "exchange must be 'alltoall', 'ring', 'fused' or 'hier', "
+                f"got {self.exchange!r}"
+            )
+        if not isinstance(self.hier_hosts, int) or self.hier_hosts < 0:
+            raise ConfigError(
+                f"hier_hosts must be an integer >= 0, got {self.hier_hosts!r}"
             )
         if not isinstance(self.redundancy, int) or self.redundancy < 1:
             raise ConfigError(
@@ -461,6 +476,7 @@ class SortConfig:
             "REDUNDANCY": "redundancy",
             "EXTERNAL_WAVE_ELEMS": "wave_elems",
             "SERVE_PREWARM": "prewarm",
+            "FLEET_DISPATCH_TIMEOUT_S": "dispatch_timeout_s",
         }
         explicit = tuple(
             sorted(knob for key, knob in _EXPLICIT_KEYS.items() if key in m)
@@ -473,6 +489,7 @@ class SortConfig:
             local_kernel=m.get("LOCAL_KERNEL", JobConfig.local_kernel),
             merge_kernel=m.get("MERGE_KERNEL", JobConfig.merge_kernel),
             exchange=m.get("EXCHANGE", JobConfig.exchange),
+            hier_hosts=geti("HIER_HOSTS", JobConfig.hier_hosts),
             redundancy=geti("REDUNDANCY", JobConfig.redundancy),
             oversample=geti("OVERSAMPLE", JobConfig.oversample),
             capacity_factor=float(

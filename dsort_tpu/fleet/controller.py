@@ -214,6 +214,11 @@ class FleetController:
             float(dispatch_timeout_s) if dispatch_timeout_s is not None
             else self.request_timeout_s
         )
+        # Whether the deadline was hand-set: the planner's
+        # dispatch_timeout_s policy (obs.plan) only fills the knob when
+        # the user left it genuinely unset — an explicit value wins and
+        # journals a plan_override (the same precedence as redundancy).
+        self._dispatch_timeout_explicit = dispatch_timeout_s is not None
         self.default_tenant = default_tenant
         self.journal = journal
         self.journal_path = journal_path
@@ -1042,6 +1047,25 @@ class FleetController:
             "redundancy", inputs, job.ticket.metrics,
         ))
 
+    def _plan_dispatch_timeout(self, job: _Job) -> float:
+        """The per-dispatch SEND deadline (obs.plan's dispatch_timeout_s
+        policy): p99 of the accept latencies this controller has observed,
+        times headroom — so a stuck agent costs its lane seconds, not the
+        full hand-set request budget.  An explicit constructor/conf value
+        always wins; with autotune on the yield journals a plan_override.
+        """
+        if not self.autotune:
+            return self.dispatch_timeout_s
+        inputs = self.planner.dispatch_timeout_inputs(self.dispatch_timeout_s)
+        if self._dispatch_timeout_explicit:
+            return float(self.planner.note_override(
+                "dispatch_timeout_s", self.dispatch_timeout_s, inputs,
+                job.ticket.metrics,
+            ))
+        return float(self.planner.decide(
+            "dispatch_timeout_s", inputs, job.ticket.metrics,
+        ))
+
     def _dispatch_one(self, link: _AgentLink, job: _Job) -> None:
         jid, tenant = job.jid, job.tenant
         try:
@@ -1049,12 +1073,13 @@ class FleetController:
             meta, payload = encode_array(payload_arr)
             planned_r = self._plan_redundancy(job)
             red = {} if planned_r is None else {"redundancy": int(planned_r)}
+            t_send = time.monotonic()
             header, _ = self._request(
                 link,
                 {"type": "submit", "job_id": jid, "tenant": tenant,
                  "label": job.label, **red, **meta},
                 payload,
-                timeout=self.dispatch_timeout_s,
+                timeout=self._plan_dispatch_timeout(job),
                 expect=("accepted", "rejected"),
             )
         except (OSError, TimeoutError, ProtocolError) as e:
@@ -1102,7 +1127,14 @@ class FleetController:
             time.sleep(0.05)
             return
         # The agent accepted: transition to inflight (the routing trace
-        # was already journaled by the dispatcher, in DRR order).
+        # was already journaled by the dispatcher, in DRR order).  The
+        # accept round-trip is journaled per dispatch — the measured
+        # input the dispatch_timeout_s policy sizes its deadline from
+        # (the planner taps this metrics object, so the fold is live).
+        job.ticket.metrics.event(
+            "job_dispatched", job_id=jid, agent=link.label(),
+            accept_latency_s=round(time.monotonic() - t_send, 6),
+        )
         with self._cv:
             if job.status != "dispatching":
                 # The result beat us here: the job is already finished

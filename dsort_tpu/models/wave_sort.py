@@ -356,11 +356,28 @@ class ExternalWaveSort:
         # plan IS the measured-histogram ring plan) and maps to "ring".
         from dsort_tpu.parallel.exchange import (
             resolve_exchange,
+            resolve_hier_hosts,
             resolve_redundancy,
         )
 
         exch = resolve_exchange(exchange, self.job.exchange, self.num_workers)
-        self.exchange = "fused" if exch == "fused" else "ring"
+        # "hier" runs each wave's exchange as the two-level schedule
+        # (ARCHITECTURE §17): cross-host waves aggregate per destination
+        # HOST before the DCN leg, so each host spills its own ranges from
+        # one merged inbound transfer per source host.
+        self.hier_hosts = 0
+        if exch == "hier":
+            self.hier_hosts = resolve_hier_hosts(
+                self.job.hier_hosts, self.num_workers
+            )
+            if self.hier_hosts < 2:
+                log.warning(
+                    "exchange='hier' needs >= 4 workers grouped into >= 2 "
+                    "hosts (have %d); waves use the lax ring schedule",
+                    self.num_workers,
+                )
+                exch = "ring"
+        self.exchange = exch if exch in ("fused", "hier") else "ring"
         # Coded redundancy (ARCHITECTURE §14): r > 1 ships every wave's
         # buckets to their r-1 ring successors too, so a device lost
         # mid-wave is repaired by a LOCAL merge of replica slots — no host
@@ -370,10 +387,11 @@ class ExternalWaveSort:
         self.redundancy = resolve_redundancy(
             redundancy, self.job.redundancy, self.num_workers
         )
-        if self.redundancy > 1 and self.exchange == "fused":
+        if self.redundancy > 1 and self.exchange != "ring":
             log.warning(
                 "redundancy=%d needs the lax ring schedule; coded waves "
-                "override exchange='fused' to 'ring'", self.redundancy,
+                "override exchange=%r to 'ring'",
+                self.redundancy, self.exchange,
             )
             self.exchange = "ring"
         #: Test seam around a wave's exchange dispatch — the same mid-ring
@@ -385,6 +403,7 @@ class ExternalWaveSort:
         self._ring_cache: dict = {}
         self._fused_cache: dict = {}
         self._coded_cache: dict = {}
+        self._hier_cache: dict = {}
         self._single_cache: dict = {}
 
     # -- compiled programs ---------------------------------------------------
@@ -566,6 +585,60 @@ class ExternalWaveSort:
                 ),
             )
             self._coded_cache[key] = fn
+        return fn
+
+    def _build_hier(self, n_local: int, plan):
+        """Two-level per-wave exchange (`exchange._hier_exchange_shard`):
+        intra-host aggregation, one DCN transfer per (src-host, dst-host)
+        pair, local scatter — a cross-host wave's spill traffic rides the
+        planned legs instead of P-1 flat transfers.  ``plan`` is the
+        `HierPlan` rung, same cache doctrine as `_build_ring`'s caps."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from dsort_tpu.obs.prof import instrument_jit
+        from dsort_tpu.parallel.exchange import _hier_exchange_shard
+        from dsort_tpu.utils.compat import shard_map
+
+        key = (n_local, plan)
+        fn = self._hier_cache.get(key)
+        if fn is None:
+            p = self.num_workers
+            body = functools.partial(
+                _hier_exchange_shard,
+                num_workers=p,
+                hosts=plan.hosts,
+                agg_cap=plan.agg_cap,
+                leg_caps=plan.leg_caps,
+                scatter_cap=plan.scatter_cap,
+                axis=self.axis,
+                merge_kernel=self.job.merge_kernel,
+                kernel=self.job.local_kernel,
+            )
+            # Donation matches `_build_ring` (repair re-sorts from the
+            # host-resident wave slice, never this buffer).
+            donate = (
+                (0,)
+                if next(iter(self.mesh.devices.flat)).platform != "cpu"
+                else ()
+            )
+            fn = instrument_jit(
+                jax.jit(
+                    shard_map(
+                        body,
+                        mesh=self.mesh,
+                        in_specs=(P(self.axis), P(self.axis), P()),
+                        out_specs=(P(self.axis),) * 3,
+                        check_vma=False,
+                    ),
+                    donate_argnums=donate,
+                ),
+                key_fn=lambda *a: (
+                    "wave_hier", p, n_local, plan, str(a[0].dtype),
+                    self.job.local_kernel,
+                ),
+            )
+            self._hier_cache[key] = fn
         return fn
 
     def _build_single(self, n_local: int):
@@ -805,6 +878,7 @@ class ExternalWaveSort:
             LEDGER.drain_to(metrics)
             return merged, np.zeros(1, bool), counts.astype(np.int64)
         fused = self.exchange == "fused"
+        hier = self.exchange == "hier"
         coded = self.redundancy > 1
         shard_spec = NamedSharding(self.mesh, P(self.axis))
         repl = NamedSharding(self.mesh, P())
@@ -818,12 +892,21 @@ class ExternalWaveSort:
             hist_h = _np.asarray(jax.device_get(hist)).reshape(p, p)
         LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
+        hplan = None
         if coded:
             from dsort_tpu.parallel.exchange import note_coded_plan
 
             note_coded_plan(
                 metrics, caps, hist_h, n_local, p, shards.dtype.itemsize,
                 self.job.capacity_factor, self.redundancy,
+            )
+        elif hier:
+            from dsort_tpu.parallel.exchange import hier_plan, note_hier_plan
+
+            hplan = hier_plan(hist_h, n_local, p, self.hier_hosts)
+            note_hier_plan(
+                metrics, hplan, caps, hist_h, n_local, p,
+                shards.dtype.itemsize, self.job.capacity_factor,
             )
         else:
             note = note_fused_plan if fused else note_ring_plan
@@ -839,6 +922,9 @@ class ExternalWaveSort:
                 merged, cnts, overflow, reps, rep_lens = codedfn(
                     xs_sorted, cj, spl
                 )
+            elif hier:
+                hierfn = self._build_hier(n_local, hplan)
+                merged, _, overflow = hierfn(xs_sorted, cj, spl)
             elif fused:
                 fusedfn = self._build_fused(n_local, caps)
                 merged, _, overflow = fusedfn(xs_sorted, cj, spl, hist)
@@ -1001,6 +1087,8 @@ class ExternalWaveTeraSort:
         resume: bool = True,
         overlap: bool = True,
         axis_name: str = "w",
+        job: JobConfig | None = None,
+        exchange: str | None = None,
     ):
         if wave_recs < 2:
             raise ValueError("wave_recs must be >= 2")
@@ -1028,8 +1116,29 @@ class ExternalWaveTeraSort:
             tempfile.gettempdir(), "dsort_external"
         )
         self.job_id = job_id
+        self.job = job or JobConfig()
         self.resume = resume
         self.overlap = overlap
+        # Exchange-knob parity with the key pipeline (override > conf
+        # EXCHANGE > default), through the one resolver seam.  The record
+        # wave's exchange is HOST-side today — each wave's sorted shards
+        # split at the fixed splitters and heap-merge on the host
+        # (`_retire_wave`) — so a mesh schedule ('ring'/'fused'/'hier')
+        # is validated and recorded but warns that no device schedule
+        # exists to select here; a silently-dropped knob would misstate
+        # the wire posture (same doctrine as `cmd_external`'s warnings).
+        from dsort_tpu.parallel.exchange import resolve_exchange
+
+        self.exchange = resolve_exchange(
+            exchange, self.job.exchange, self.num_workers
+        )
+        if self.exchange != "alltoall":
+            log.warning(
+                "the record wave pipeline's exchange is host-side (split "
+                "+ native merge); exchange=%r selects no device schedule "
+                "here yet — see ARCHITECTURE §17 for the planned kv hier "
+                "leg", self.exchange,
+            )
         self.fault_hook = None
         self._sort_cache: dict = {}
 

@@ -41,7 +41,9 @@ from collections import Counter, deque
 import numpy as np
 
 #: The policy catalog — one entry per knob the planner may fill.
-PLAN_POLICIES = ("exchange", "wave_elems", "redundancy", "prewarm")
+PLAN_POLICIES = (
+    "exchange", "wave_elems", "redundancy", "prewarm", "dispatch_timeout_s",
+)
 
 #: Fields every ``plan_decision`` event carries (schema, test-enforced).
 PLAN_DECISION_FIELDS = ("policy", "chosen", "inputs", "rejected")
@@ -70,6 +72,16 @@ WAVE_MAX_ELEMS = 1 << 26
 REDUNDANCY_DEGRADED_FRAC = 0.25
 #: Admissions remembered for the prewarm rung x dtype mix.
 PREWARM_HISTORY = 64
+#: Headroom multiplier over the observed p99 dispatch-accept latency: the
+#: planned send deadline must absorb a tail excursion without failing over a
+#: healthy-but-momentarily-slow agent (the failover re-route costs a full
+#: re-dispatch plus a journaled job_rerouted).
+DISPATCH_TIMEOUT_HEADROOM = 8.0
+#: Floor for the planned send deadline — below this the socket round-trip
+#: itself (connect + encode + accept) dominates the budget.
+DISPATCH_TIMEOUT_MIN_S = 1.0
+#: Dispatch-accept latencies remembered for the rolling p99.
+DISPATCH_LATENCY_HISTORY = 256
 
 
 def plan_rung(n: int) -> int:
@@ -161,6 +173,32 @@ def _decide_exchange(inputs: dict) -> tuple[str, list[dict]]:
             {"value": "fused",
              "reason": f"redundancy={red}: the fused kernel carries no "
                        "replica slots"},
+        ]
+    hosts = int(inputs.get("hosts", 0))
+    if hosts >= 2 and p // hosts >= 2:
+        # A >=2-host grouping with >=2 devices per host: the two-level
+        # schedule aggregates each host's contributions per destination
+        # host and ships ONE merged transfer per (src-host, dst-host)
+        # pair, so the DCN leg scales with the data crossing hosts, not
+        # with P.  At 1 device/host there is nothing to aggregate (every
+        # transfer is already cross-host) — fall through to the flat
+        # skew decision.
+        d = p // hosts
+        return "hier", [
+            {"value": "alltoall",
+             "reason": f"{hosts}-host topology: the padded collective "
+                       "ships every (src, dst) device bucket across hosts "
+                       "individually; aggregation sends one merged "
+                       "transfer per host pair on the DCN leg"},
+            {"value": "ring",
+             "reason": f"{hosts}-host topology ({d} devices/host): the "
+                       "flat ring pushes full per-device buffers over the "
+                       "host boundary on most steps; the two-level "
+                       "schedule moves that traffic onto the intra-host "
+                       "fabric"},
+            {"value": "fused",
+             "reason": "the fused kernel runs the FLAT ring schedule; it "
+                       "has no host-aggregated DCN leg"},
         ]
     if skew >= thr:
         rejected = [
@@ -274,11 +312,45 @@ def _decide_prewarm(inputs: dict) -> tuple[list, list[dict]]:
     return chosen, rejected
 
 
+def _decide_dispatch_timeout_s(inputs: dict) -> tuple[float, list[dict]]:
+    """The fleet's per-agent SEND deadline, sized from what dispatch
+    actually costs: p99 of the observed accept latencies x headroom.  The
+    hand-set default (request_timeout_s, 30 s) parks a job behind a stuck
+    agent for the full request budget; the measured deadline fails over in
+    seconds while the headroom keeps a healthy agent's tail excursion from
+    tripping a spurious re-route."""
+    cur = float(inputs.get("current", 0.0) or 0.0)
+    p99 = float(inputs.get("p99_s", 0.0) or 0.0)
+    samples = int(inputs.get("samples", 0))
+    if samples <= 0 or p99 <= 0:
+        return cur, [
+            {"value": "resize",
+             "reason": "no dispatch-accept latency observed yet: keeping "
+                       "dispatch_timeout_s"},
+        ]
+    chosen = round(max(DISPATCH_TIMEOUT_MIN_S,
+                       p99 * DISPATCH_TIMEOUT_HEADROOM), 3)
+    rejected = [
+        {"value": round(p99, 6),
+         "reason": f"the bare p99 of {samples} accept(s) fails over a "
+                   f"healthy agent on any tail excursion "
+                   f"({DISPATCH_TIMEOUT_HEADROOM:g}x headroom applied)"},
+    ]
+    if chosen != cur:
+        rejected.append(
+            {"value": cur,
+             "reason": f"measured p99 {p99} s x "
+                       f"{DISPATCH_TIMEOUT_HEADROOM:g} headroom resized "
+                       "the send deadline"})
+    return chosen, rejected
+
+
 _POLICY_FNS = {
     "exchange": _decide_exchange,
     "wave_elems": _decide_wave_elems,
     "redundancy": _decide_redundancy,
     "prewarm": _decide_prewarm,
+    "dispatch_timeout_s": _decide_dispatch_timeout_s,
 }
 
 
@@ -313,6 +385,7 @@ class Planner:
         self.job = job
         self._lock = threading.Lock()
         self._admissions: deque = deque(maxlen=int(history))
+        self._dispatch_lat: deque = deque(maxlen=DISPATCH_LATENCY_HISTORY)
         self._hbm_peak = 0
         self._max_device_bytes = 0
         self._loss_events = 0
@@ -348,6 +421,12 @@ class Planner:
                     self._max_device_bytes,
                     int(fields.get("max_device_bytes", 0) or 0),
                 )
+            elif etype == "job_dispatched":
+                # The accept round-trip the send deadline must cover — the
+                # dispatch_timeout_s policy's measured input.
+                lat = fields.get("accept_latency_s")
+                if lat:
+                    self._dispatch_lat.append(float(lat))
             elif etype == "worker_dead":
                 self._loss_events += 1
             elif (etype == "job_rerouted"
@@ -366,6 +445,7 @@ class Planner:
         with self._lock:
             return {
                 "admissions": list(self._admissions),
+                "dispatch_latencies": [float(x) for x in self._dispatch_lat],
                 "hbm_peak": self._hbm_peak,
                 "max_device_bytes": self._max_device_bytes,
                 "loss_events": self._loss_events,
@@ -461,6 +541,16 @@ class Planner:
             "limit": int(limit),
         }
 
+    def dispatch_timeout_inputs(self, current: float | None = None) -> dict:
+        st = self.state_dict()
+        lats = st["dispatch_latencies"]
+        p99 = float(np.percentile(lats, 99)) if lats else 0.0
+        return {
+            "current": float(current or 0.0),
+            "p99_s": round(p99, 6),
+            "samples": len(lats),
+        }
+
     def redundancy_inputs(self, current: int = 1,
                           scores: dict | None = None) -> dict:
         st = self.state_dict()
@@ -496,12 +586,16 @@ class Planner:
 
 def planned_exchange(job, data, num_workers: int, metrics=None,
                      call_value=None, fused_ok: bool = False,
-                     redundancy: int | None = None):
+                     redundancy: int | None = None, hosts: int = 0):
     """The `SampleSort._dispatch_keys` autotune seam.
 
     Returns the exchange value to resolve (explicit > planner) or None
     (autotune off, nothing explicit: the config default applies
-    unplanned, exactly the pre-planner behavior).
+    unplanned, exactly the pre-planner behavior).  ``hosts`` is the
+    MEASURED host topology (the caller's `resolve_hier_hosts` result —
+    this module is backend-free and cannot probe the process count
+    itself); >= 2 with >= 2 devices per host arms the two-level "hier"
+    schedule.
     """
     if job is None or not getattr(job, "autotune", False):
         return call_value
@@ -509,6 +603,7 @@ def planned_exchange(job, data, num_workers: int, metrics=None,
     explicit = planner.explicit_value("exchange", call_value)
     inputs = probe_skew(data, num_workers)
     inputs["fused_ok"] = bool(fused_ok)
+    inputs["hosts"] = int(hosts)
     inputs["redundancy"] = int(
         redundancy if redundancy is not None
         else getattr(job, "redundancy", 1)

@@ -116,6 +116,68 @@ def _build_mh_program(
     )
 
 
+@functools.lru_cache(maxsize=64)
+def _build_mh_plan_program(mesh, axis_name, p_total, oversample, kernel):
+    """jit(shard_map(...)) of the measured-histogram plan phase over the
+    GLOBAL mesh (`exchange._ring_plan_shard`), cached like
+    `_build_mh_program`.  The replicated ``(P, P)`` histogram it returns is
+    identical on every process, so host-side capacity planning from it is
+    lockstep-safe by construction — no extra agreement barrier needed."""
+    from jax.sharding import PartitionSpec as P
+
+    from dsort_tpu.parallel.exchange import _ring_plan_shard
+    from dsort_tpu.utils.compat import shard_map
+
+    fn = functools.partial(
+        _ring_plan_shard, num_workers=p_total, oversample=oversample,
+        axis=axis_name, kernel=kernel,
+    )
+    return jax.jit(
+        shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name)),
+            out_specs=(P(axis_name), P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_mh_hier_program(
+    mesh, axis_name, p_total, plan, kernel, merge_kernel
+):
+    """jit(shard_map(...)) of the two-level exchange over the GLOBAL mesh
+    (`exchange._hier_exchange_shard`): the intra-host aggregation ring, ONE
+    merged transfer per (src-host, dst-host) pair on the DCN leg, the local
+    scatter + merge.  ``plan`` is a `HierPlan` — hashable, every cap on the
+    quantization ladder, so the compile cache stays rung-bounded exactly
+    like `_build_mh_program`'s ``cap_pair`` key."""
+    from jax.sharding import PartitionSpec as P
+
+    from dsort_tpu.parallel.exchange import _hier_exchange_shard
+    from dsort_tpu.utils.compat import shard_map
+
+    fn = functools.partial(
+        _hier_exchange_shard,
+        num_workers=p_total,
+        hosts=plan.hosts,
+        agg_cap=plan.agg_cap,
+        leg_caps=plan.leg_caps,
+        scatter_cap=plan.scatter_cap,
+        axis=axis_name,
+        merge_kernel=merge_kernel,
+        kernel=kernel,
+    )
+    return jax.jit(
+        shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name), P()),
+            out_specs=(P(axis_name),) * 3,
+            check_vma=False,
+        )
+    )
+
+
 def _agree_cap(n_items: int, n_local_devices: int) -> int:
     """One global per-device shard capacity, agreed across unequal hosts."""
     import numpy as np
@@ -372,6 +434,39 @@ def _sort_local_shards_plain(local_data, job, axis_name, metrics):
     replicated = NamedSharding(mesh, P())
     any_overflow = jax.jit(jnp.any, out_shardings=replicated)
     global_max = jax.jit(jnp.max, out_shardings=replicated)
+
+    # The pod-scale exchange choice.  "hier" drops the padded alltoall for
+    # the two-level schedule (measured plan -> intra-host aggregation ->
+    # ONE DCN transfer per host pair -> local scatter) whenever a
+    # >=2-host grouping divides the global mesh; "ring"/"fused" have no
+    # multihost port yet (their P-1 ppermute schedule is tuned for one
+    # slice's ICI), so they keep the alltoall here — a DOCUMENTED
+    # downgrade, not a silent one (ARCHITECTURE §17).
+    from dsort_tpu.parallel.exchange import (
+        resolve_exchange,
+        resolve_hier_hosts,
+    )
+
+    exch = resolve_exchange(None, job.exchange, p_total)
+    if exch == "hier":
+        hosts = resolve_hier_hosts(getattr(job, "hier_hosts", 0), p_total)
+        if hosts >= 2:
+            return _sort_local_shards_hier(
+                job, axis_name, metrics, timer, mesh, p_total, xs, cj,
+                cap, hosts, any_overflow,
+            )
+        log.warning(
+            "exchange='hier' needs >= 4 global devices grouped into >= 2 "
+            "hosts (have %d); the multihost driver falls back to the "
+            "padded all_to_all", p_total,
+        )
+    elif exch != "alltoall":
+        log.warning(
+            "exchange=%r has no multihost schedule yet; the pod driver "
+            "uses the padded all_to_all (exchange='hier' is the two-level "
+            "pod schedule)", exch,
+        )
+
     cap_pair = _cap_pair_for(job.capacity_factor, cap, p_total)
     for _ in range(job.max_capacity_retries + 1):
         fn = _build_mh_program(
@@ -397,6 +492,62 @@ def _sort_local_shards_plain(local_data, job, axis_name, metrics):
     else:
         raise RuntimeError("sample sort bucket overflow after max retries")
 
+    with timer.phase("assemble"):
+        (local_sorted,), offset = _per_host_egress(out_counts, [(merged, ())])
+    return local_sorted, offset
+
+
+def _sort_local_shards_hier(
+    job, axis_name, metrics, timer, mesh, p_total, xs, cj, cap, hosts,
+    any_overflow,
+):
+    """The pod-wide TWO-LEVEL exchange core: the multihost path's
+    ``exchange='hier'`` arm, replacing the padded all_to_all entirely.
+
+    Phase plan runs `exchange._ring_plan_shard` over the GLOBAL mesh; its
+    ``(P, P)`` histogram is replicated, so every process fetches the same
+    matrix and `exchange.hier_plan` computes bit-identical caps in lockstep
+    — capacity planning needs no extra agreement barrier (contrast
+    `_agree_cap`'s allgather).  No capacity-retry loop exists either: every
+    phase's buffer was sized from the measured histogram before the
+    exchange ran, so overflow is an invariant violation, raised loudly
+    (`check_ring_overflow`) — the same no-retry doctrine as the
+    single-host ring.
+
+    When launched multi-process with ``job.hier_hosts=0`` the host
+    grouping IS the process topology (`resolve_hier_hosts` auto), so the
+    one aggregated transfer per (src-host, dst-host) pair is exactly the
+    traffic that crosses the DCN.
+    """
+    import numpy as np
+
+    from dsort_tpu.parallel.exchange import (
+        check_ring_overflow,
+        hier_plan,
+        note_hier_plan,
+        ring_caps,
+    )
+
+    planfn = _build_mh_plan_program(
+        mesh, axis_name, p_total, job.oversample, job.local_kernel
+    )
+    with timer.phase("spmd_sort"):
+        xs_sorted, splitters, hist = planfn(xs, cj)
+        # Replicated output: this fetch reads only addressable shards and
+        # yields the SAME (P, P) matrix on every process.
+        hist_h = jax.device_get(hist)
+    caps = ring_caps(hist_h, cap, p_total)  # flat baseline for the credit
+    plan = hier_plan(hist_h, cap, p_total, hosts)
+    note_hier_plan(
+        metrics, plan, caps, hist_h, cap, p_total,
+        np.dtype(xs.dtype).itemsize, job.capacity_factor,
+    )
+    hierfn = _build_mh_hier_program(
+        mesh, axis_name, p_total, plan, job.local_kernel, job.merge_kernel
+    )
+    with timer.phase("spmd_sort"):
+        merged, out_counts, overflow = hierfn(xs_sorted, cj, splitters)
+        check_ring_overflow(jax.device_get(any_overflow(overflow)))
     with timer.phase("assemble"):
         (local_sorted,), offset = _per_host_egress(out_counts, [(merged, ())])
     return local_sorted, offset

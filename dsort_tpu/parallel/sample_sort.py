@@ -695,6 +695,87 @@ class SampleSort:
             ),
         )
 
+    @functools.lru_cache(maxsize=32)
+    def _build_hier(self, n_local: int, plan):
+        """Two-level exchange phase for one planned capacity rung
+        (`exchange._hier_exchange_shard`): the intra-host aggregation ring,
+        one merged DCN transfer per (src-host, dst-host) pair, the local
+        scatter + merge — all in one program.  ``plan`` is a `HierPlan`:
+        every cap sits on the same quantization ladder as `_build_ring`'s
+        ``caps`` tuple, so the compile cache stays rung-bounded.  Same
+        donation policy as `_build_ring` (keys-only path, no retry)."""
+        from dsort_tpu.parallel.exchange import _hier_exchange_shard
+
+        fn = functools.partial(
+            _hier_exchange_shard,
+            num_workers=self.num_workers,
+            hosts=plan.hosts,
+            agg_cap=plan.agg_cap,
+            leg_caps=plan.leg_caps,
+            scatter_cap=plan.scatter_cap,
+            axis=self.axis,
+            merge_kernel=self.job.merge_kernel,
+            kernel=self.job.local_kernel,
+        )
+        return instrument_jit(
+            jax.jit(
+                shard_map(
+                    fn, mesh=self.mesh,
+                    in_specs=(P(self.axis), P(self.axis), P()),
+                    out_specs=(P(self.axis),) * 3, check_vma=False,
+                ),
+                donate_argnums=self._donate_keys(False),
+            ),
+            key_fn=lambda *a: (
+                "spmd_hier", self.num_workers, n_local, plan,
+                str(a[0].dtype), self.job.local_kernel,
+            ),
+        )
+
+    def _dispatch_keys_hier(
+        self, data: np.ndarray, timer, metrics: Metrics, hosts: int
+    ):
+        """Hier counterpart of `_dispatch_keys_ring`: plan once, reduce the
+        measured (P, P) histogram to the (H, H) host matrix, dispatch the
+        three-phase program.  Same no-retry doctrine — every phase's buffer
+        was sized from the measured histogram before the exchange ran, so
+        overflow is an invariant violation, not a capacity miss.  The flat
+        ring caps for the SAME histogram are computed too: they price the
+        ``dcn_bytes_saved`` baseline in `note_hier_plan`."""
+        from dsort_tpu.parallel.exchange import (
+            check_ring_overflow,
+            hier_plan,
+            note_hier_plan,
+            ring_caps,
+        )
+
+        p = self.num_workers
+        shard_spec = NamedSharding(self.mesh, P(self.axis))
+        with timer.phase("partition"):
+            shards, counts = pad_to_shards(data, p)
+            xs, cj = jax.device_put((shards.reshape(-1), counts), shard_spec)
+        n_local = shards.shape[1]
+        planfn = self._build_plan(n_local)
+        with timer.phase("spmd_sort"):
+            xs_sorted, splitters, hist = planfn(xs, cj)
+            hist_h = jax.device_get(hist)
+        LEDGER.drain_to(metrics)
+        caps = ring_caps(hist_h, n_local, p)
+        plan = hier_plan(hist_h, n_local, p, hosts)
+        note_hier_plan(
+            metrics, plan, caps, hist_h, n_local, p, data.dtype.itemsize,
+            self.job.capacity_factor,
+        )
+        if self.fault_hook is not None:
+            self.fault_hook()
+        with timer.phase("spmd_sort"):
+            hierfn = self._build_hier(n_local, plan)
+            merged, out_counts, overflow = hierfn(xs_sorted, cj, splitters)
+            c, ov = jax.device_get((out_counts, overflow))
+        LEDGER.drain_to(metrics)
+        check_ring_overflow(ov)
+        return merged, out_counts, c
+
     def _dispatch_keys_ring(
         self, data: np.ndarray, timer, metrics: Metrics, fused: bool = False,
         redundancy: int = 1,
@@ -990,19 +1071,47 @@ class SampleSort:
         red = self._resolve_redundancy(redundancy)
         if getattr(self.job, "autotune", False):
             from dsort_tpu.obs.plan import planned_exchange
+            from dsort_tpu.parallel.exchange import resolve_hier_hosts
 
             fused_ok = all(
                 d.platform == "tpu" for d in self.mesh.devices.flat
             )
+            # The planner's measured host topology (obs.plan is
+            # backend-free, so the probe happens here): >= 2 hosts with
+            # >= 2 devices each arms the two-level "hier" schedule.  Only
+            # a REAL topology signal counts — a multi-process launch or a
+            # requested hier_hosts grouping; the simulated 2-host default
+            # must not re-route every >= 4-device single-slice run
+            # through a DCN leg that does not exist.
+            want = getattr(self.job, "hier_hosts", 0)
+            hosts = (
+                resolve_hier_hosts(want, self.num_workers)
+                if want or jax.process_count() > 1 else 0
+            )
             exchange = planned_exchange(
                 self.job, data, self.num_workers, metrics,
                 call_value=exchange, fused_ok=fused_ok, redundancy=red,
+                hosts=hosts,
             )
         exch = self._resolve_exchange(exchange)
         if red > 1 and exch != "ring":
             log.warning(
                 "redundancy=%d needs the lax ring schedule; overriding "
                 "exchange=%r to 'ring' for this dispatch", red, exch,
+            )
+            exch = "ring"
+        if exch == "hier":
+            from dsort_tpu.parallel.exchange import resolve_hier_hosts
+
+            hosts = resolve_hier_hosts(
+                getattr(self.job, "hier_hosts", 0), self.num_workers
+            )
+            if hosts >= 2:
+                return self._dispatch_keys_hier(data, timer, metrics, hosts)
+            log.warning(
+                "exchange='hier' needs >= 4 workers grouped into >= 2 "
+                "hosts (have %d); downgrading to the flat ring schedule",
+                self.num_workers,
             )
             exch = "ring"
         if exch in ("ring", "fused"):
@@ -1144,6 +1253,15 @@ class SampleSort:
                 "redundancy=%d applies to keys-only jobs; this kv sort "
                 "runs uncoded (re-run recovery)", self.job.redundancy,
             )
+        if exch == "hier":
+            # The two-level schedule is keys-only today: the payload plane
+            # would need tag channels through both the aggregation merge and
+            # the scatter merge (ARCHITECTURE §17 scope).
+            log.warning(
+                "exchange='hier' is keys-only; this kv sort uses the lax "
+                "ring schedule",
+            )
+            exch = "ring"
         if exch in ("ring", "fused") and secondary is not None:
             # The ring's tag plane carries (is_pad, position); adding the
             # secondary would need a third merge channel per fold — the
@@ -1635,6 +1753,14 @@ class BatchSampleSort:
             # lax ring (same caps, same bytes, P-1 dispatches per bucket).
             log.warning(
                 "exchange='fused' is single-job only; the batch uses the "
+                "lax ring exchange"
+            )
+            exch = "ring"
+        if exch == "hier":
+            # The two-level schedule keys its host grouping off the 1-D
+            # worker axis; the batched (dp, w) mesh keeps the flat ring.
+            log.warning(
+                "exchange='hier' is single-job only; the batch uses the "
                 "lax ring exchange"
             )
             exch = "ring"

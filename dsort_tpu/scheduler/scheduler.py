@@ -1196,9 +1196,28 @@ class SpmdScheduler:
                     self.table.mark_dead(w)
                     metrics.event("worker_dead", worker=w, stage=e.stage)
                 metrics.bump("mesh_reforms")
-                metrics.event(
-                    "mesh_reform", survivors=len(live) - len(dead_workers)
-                )
+                survivors = len(live) - len(dead_workers)
+                metrics.event("mesh_reform", survivors=survivors)
+                if (exchange or self.job.exchange) == "hier":
+                    # The two-level fault contract (ARCHITECTURE §17): the
+                    # re-formed mesh re-resolves its host grouping — a lost
+                    # device re-forms within its host (H unchanged, one
+                    # fewer device per host); a lost HOST re-plans the
+                    # (H', H') leg schedule on the largest divisor the
+                    # survivors still support, or downgrades to the flat
+                    # ring when none exists.  Journaled BEFORE the re-run
+                    # so the trace shows the re-plan decision, not just
+                    # its effect.
+                    from dsort_tpu.parallel.exchange import resolve_hier_hosts
+
+                    want = getattr(self.job, "hier_hosts", 0)
+                    before = resolve_hier_hosts(want, len(live))
+                    after = resolve_hier_hosts(want, survivors)
+                    metrics.event(
+                        "hier_reform", survivors=survivors,
+                        hosts_before=before, hosts_after=after,
+                        downgraded=after < 2,
+                    )
                 self._invalidate_handles("mesh_reform", metrics)
                 self._notify_reform(dead_workers)
                 # Coded redundancy (ARCHITECTURE §14): when the failed

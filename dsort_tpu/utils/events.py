@@ -124,6 +124,10 @@ EVENT_TYPES: dict[str, str] = {
     "job_rerouted": "a routed/in-flight job re-entered the fleet queue "
                     "after its agent drained, died, or forgot it (job_id, "
                     "tenant, frm, reason, readmits)",
+    "job_dispatched": "an agent accepted a dispatched job — the submit "
+                      "round-trip the send deadline must cover (job_id, "
+                      "agent, accept_latency_s; the dispatch_timeout_s "
+                      "policy's measured input)",
     "controller_restore": "a restarted fleet controller restored its "
                           "persisted queue + in-flight state (controller, "
                           "queued, inflight, agents)",
@@ -159,6 +163,18 @@ EVENT_TYPES: dict[str, str] = {
                      "while autotune was on (policy, explicit — the value "
                      "that won, planned — what the planner would have "
                      "chosen, inputs)",
+    # Hierarchical exchange plane (parallel.exchange, ARCHITECTURE §17):
+    "hier_exchange_plan": "one two-level exchange was sized from the (H,H) "
+                          "host matrix (hosts, dev_per_host, legs, agg_cap, "
+                          "scatter_cap, dcn_bytes, intra_bytes, "
+                          "flat_ring_dcn_bytes)",
+    "hier_exchange_leg": "one planned host-shift DCN leg of the two-level "
+                         "exchange — H aggregated transfers, one per "
+                         "(src-host, dst-host) pair (shift, cap, bytes)",
+    "hier_reform": "the host grouping re-planned after a loss — a lost "
+                   "device re-forms within its host; a lost host shrinks "
+                   "the (H,H) legs to survivors or downgrades to the flat "
+                   "ring (survivors, hosts_before, hosts_after, downgraded)",
     # Out-of-core wave pipeline (models.wave_sort, ARCHITECTURE §10):
     "wave_start": "one input wave entered the mesh pipeline "
                   "(wave, n_keys)",
@@ -252,6 +268,17 @@ COUNTERS: dict[str, str] = {
     "plan_overrides": "explicit flag/conf values that won over the planner "
                       "while autotune was on (each journals a "
                       "plan_override)",
+    "hier_exchanges": "two-level (intra-host x DCN-leg) exchanges planned "
+                      "and dispatched (parallel.exchange hier schedule)",
+    "dcn_bytes_on_wire": "bytes the two-level exchange shipped over the "
+                         "inter-host DCN legs (also charged to "
+                         "exchange_bytes_on_wire)",
+    "intra_host_bytes_on_wire": "bytes the two-level exchange kept on the "
+                                "fast intra-host fabric (also charged to "
+                                "exchange_bytes_on_wire)",
+    "dcn_bytes_saved": "inter-host bytes the two-level schedule avoided vs "
+                       "the flat ring's cross-host transfers for the same "
+                       "measured histogram",
     "waves_sorted": "input waves run through the mesh exchange pipeline",
     "wave_runs_resorted": "(wave, run) store entries re-sorted by the "
                           "run-granular resume/repair path",

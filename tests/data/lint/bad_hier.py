@@ -1,0 +1,43 @@
+"""Fixture: hierarchical-exchange discipline violations (DS201/DS202 + DS301).
+
+Models the §17 plane's two riskiest shapes: a host-topology table whose
+grouping slots must stay lock-guarded with no blocking work under the
+lock (the (H,H) re-plan is a device_get + NumPy reduction of the whole
+measured histogram — holding the table lock across it would serialize
+every concurrently-re-forming job's recovery behind one host sync), and
+a shard program that must never journal its DCN accounting from inside a
+traced function (the wire-byte split would become a trace-time constant
+and `hier_exchange_plan` would fire once per compile, not per exchange).
+"""
+
+import threading
+import time
+
+import jax
+
+
+class HostTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groupings = {}
+        self._replans = []
+
+    def park(self, hosts, plan):
+        with self._lock:
+            self._groupings[hosts] = plan
+
+    def park_racy(self, hosts, plan):
+        self._groupings[hosts] = plan  # DS201: guarded attribute, no lock held
+
+    def replan_under_lock(self, reduce_hist, survivors):
+        with self._lock:
+            time.sleep(0.01)  # DS202: the settle delay, lock held
+            return reduce_hist.wait()  # DS202: blocking (H,H) reduction under the lock
+
+
+@jax.jit
+def hier_shard_with_journal(xs, metrics):
+    metrics.event("hier_exchange_plan", hosts=4, dcn_bytes=7)  # DS301
+    t0 = time.perf_counter()  # DS301: DCN leg wall clock baked at trace
+    print("leg dispatched at", t0)  # DS301
+    return xs + 1

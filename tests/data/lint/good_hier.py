@@ -1,0 +1,46 @@
+"""Fixture: near-miss clean twin of bad_hier — all discipline kept.
+
+The shapes `parallel.exchange`'s hier section actually ships: lock held
+only for the grouping dict, the (H,H) histogram reduction and the
+`hier_exchange_plan` journal both OUTSIDE the lock (note_hier_plan is
+host-side), and the DCN leg wall time measured AROUND the dispatch,
+never inside a traced shard function.
+"""
+
+import threading
+import time
+
+import jax
+
+
+class HostTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groupings = {}
+        self._replans = []
+
+    def park(self, hosts, plan):
+        with self._lock:
+            self._groupings[hosts] = plan
+            self._replans.append(hosts)
+
+    def take(self, hosts):
+        with self._lock:  # swap the plan out under the lock ...
+            return self._groupings.pop(hosts, None)
+
+    def replan_outside_lock(self, reduce_hist, survivors):
+        stale = self.take(survivors)  # lock released inside take
+        return reduce_hist.run(stale)  # the (H,H) reduction never holds the lock
+
+
+@jax.jit
+def pure_hier_shard(xs):
+    return xs + 1
+
+
+def plan_around_trace(xs, metrics):
+    t0 = time.perf_counter()  # host-side wall clock AROUND the traced call
+    ys = pure_hier_shard(xs)
+    metrics.event("hier_exchange_plan", hosts=4,
+                  wall_s=time.perf_counter() - t0)
+    return ys

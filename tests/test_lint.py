@@ -139,6 +139,18 @@ def test_plan_fixture():
     assert run_fixture("good_plan.py") == []
 
 
+def test_hier_fixture():
+    """ISSUE 18: the hierarchical exchange plane's discipline contract —
+    the host-topology table stays lock-guarded with the (H,H) histogram
+    re-plan outside the lock, and no hier_exchange_plan event (or DCN
+    wall clock) is emitted from inside a traced shard function (the
+    wire-byte split would become a trace-time constant)."""
+    diags = run_fixture("bad_hier.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS201": 1, "DS202": 2, "DS301": 3}
+    assert run_fixture("good_hier.py") == []
+
+
 def test_durability_checker_fixture():
     """ISSUE 13: the PR 12 review-fix classes stay pinned — a raw write to
     a persisted-state path, a rename with no fsync, and persist IO under a

@@ -57,7 +57,10 @@ def test_plan_events_and_counters_registered():
         assert etype in EVENT_TYPES
     for counter in ("plan_decisions", "plan_overrides"):
         assert counter in COUNTERS
-    assert PLAN_POLICIES == ("exchange", "wave_elems", "redundancy", "prewarm")
+    assert PLAN_POLICIES == (
+        "exchange", "wave_elems", "redundancy", "prewarm",
+        "dispatch_timeout_s",
+    )
     assert PLAN_DECISION_FIELDS == ("policy", "chosen", "inputs", "rejected")
     assert PLAN_OVERRIDE_FIELDS == ("policy", "explicit", "planned", "inputs")
 
