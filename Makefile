@@ -55,6 +55,11 @@ coded-smoke:  ## coded-redundancy failure A/B: redundancy=1 vs 2, healthy vs one
 	$(PY) -m dsort_tpu.cli bench --coded-ab --n 131072 --reps 1 \
 	--journal /tmp/dsort_coded_smoke.jsonl
 
+coded-v2-smoke:  ## coded v2 acceptance A/B: parity-vs-replicate wire premium, per-mode loss drills, straggler p99 race (8-device cpu mesh)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m dsort_tpu.cli bench --coded-v2-ab --n 131072 --reps 1 \
+	--journal /tmp/dsort_coded_v2_smoke.jsonl
+
 autotune-smoke:  ## closed-loop planner A/B: hand-set alltoall/ring vs planner-chosen exchange, bit-identical + correct-pick gate (8-device cpu mesh)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -m dsort_tpu.cli bench --autotune-ab --n 131072 --reps 1 \
@@ -93,4 +98,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke spec-smoke profile-smoke external-smoke coded-smoke autotune-smoke hier-smoke bench-compare bench-history native tsan asan ubsan sanitize
+.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke spec-smoke profile-smoke external-smoke coded-smoke coded-v2-smoke autotune-smoke hier-smoke bench-compare bench-history native tsan asan ubsan sanitize

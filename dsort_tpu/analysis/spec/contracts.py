@@ -58,13 +58,47 @@ TRACE_CONTRACTS = {
     # SPMD path, or the eviction-readmission pair on the serve path
     # (serve journals the loss as `job_evicted`, not `worker_dead`).
     # Extra trigger pairs without a reconstruct are the re-run posture
-    # and legal in the same journal.
+    # and legal in the same journal.  The free-standing alternative is
+    # the wave pipeline's inline reconstruct (§18): a coded wave loss
+    # never re-forms the mesh or evicts the job — the wave completes
+    # from the replica/retained plane and the pipeline moves on.
     "coded_recovery": {
         "scope": (),
         "when": ("coded_recover",),
         "steps": (
             "( worker_dead mesh_reform coded_recover?",
-            "| job_evicted job_readmitted coded_recover? )+",
+            "| job_evicted job_readmitted coded_recover?",
+            "| coded_recover )+",
+        ),
+    },
+    # The §18 parity twin of `coded_recovery`: a parity reconstruction
+    # follows the same trigger shapes — device death + mesh re-form on
+    # the SPMD path, evict + readmit on the serve path — plus one more:
+    # the wave pipeline journals its reconstruct INLINE (the mesh
+    # survives a coded wave loss; nothing re-forms and nothing is
+    # evicted), so a free-standing `parity_recover` is the third legal
+    # shape there.
+    "parity_recovery": {
+        "scope": (),
+        "when": ("parity_recover",),
+        "steps": (
+            "( worker_dead mesh_reform parity_recover?",
+            "| job_evicted job_readmitted parity_recover?",
+            "| parity_recover )+",
+        ),
+    },
+    # The §18 straggler-first serve: per (job, range), the exactly-once
+    # claim means at most ONE `coded_straggler_serve` ever lands, with
+    # the racing owner leg's `coded_owner_fetch` on either side of it
+    # (the owner thread finishes before or after the holder — both
+    # legal; `won` says who took the claim).  An owner-win race journals
+    # only the fetch and is not checked (the `when` gate), matching the
+    # no-serve outcome.
+    "straggler_serve": {
+        "scope": ("job", "range"),
+        "when": ("coded_straggler_serve",),
+        "steps": (
+            "coded_owner_fetch? coded_straggler_serve coded_owner_fetch?",
         ),
     },
     # The PR-12 restart contract, trace-side: a restarted controller

@@ -97,6 +97,8 @@ def _load_config(args) -> SortConfig:
         job_over["hier_hosts"] = args.hier_hosts
     if getattr(args, "redundancy", None):
         job_over["redundancy"] = args.redundancy
+    if getattr(args, "redundancy_mode", None):
+        job_over["redundancy_mode"] = args.redundancy_mode
     if getattr(args, "checkpoint_dir", None):
         job_over["checkpoint_dir"] = args.checkpoint_dir
     if getattr(args, "tenant", None):
@@ -112,6 +114,10 @@ def _load_config(args) -> SortConfig:
         explicit.add("exchange")
     if getattr(args, "redundancy", None):
         explicit.add("redundancy")
+    if getattr(args, "redundancy_mode", None):
+        explicit.add("redundancy_mode")
+    if getattr(args, "slice_devices", None):
+        explicit.add("slice_devices")
     if getattr(args, "prewarm", None) == "all":
         explicit.add("prewarm")
     if explicit != set(cfg.job.explicit):
@@ -839,6 +845,10 @@ def cmd_fleet(args) -> int:
         redundancy=(
             cfg.job.redundancy if cfg.job.is_explicit("redundancy") else None
         ),
+        redundancy_mode=(
+            cfg.job.redundancy_mode
+            if cfg.job.is_explicit("redundancy_mode") else None
+        ),
     )
     if controller.stats()["agents"] == 0:
         log.warning(
@@ -1377,6 +1387,212 @@ def _bench_coded_ab(args, cfg: SortConfig) -> int:
             "mesh_reforms": f2["mesh_reforms"],
             "includes_reform_and_recompile": True,
             "bit_identical": all(a["identical"] for a in arms.values()),
+        }), flush=True)
+    finally:
+        _write_journal(journal, args)
+    return 0 if ok_all else 1
+
+
+def _bench_coded_v2_ab(args, cfg: SortConfig) -> int:
+    """`dsort bench --coded-v2-ab`: the coded-exchange v2 acceptance A/B.
+
+    The `make coded-v2-smoke` target (tier-1-gated) and THE acceptance
+    harness for the v2 parity plane + straggler serving (ARCHITECTURE
+    §18): the §14 zipf workload through `SpmdScheduler` at r=2,
+    replicate vs parity — equal single-loss survivability — plus the
+    injected-straggler drill.  Three rows, all gated (ok -> exit 0):
+
+    - ``coded_v2_parity_premium``: healthy-path wire premium.  Parity
+      must ship < 0.75x replicate's measured ``coded_replica_bytes`` on
+      the same plan (one XOR slot vs a full replica per range).
+    - ``coded_v2_parity_failure``: one injected mid-ring loss per mode.
+      BOTH modes must recover locally — exactly one coded recovery per
+      faulted sort, zero re-sorted keys (the parity arm SOLVES the dead
+      range from its XOR slot; faulted reps run on a fresh scheduler
+      with the healthy warm pass off the clock, the §14 semantics).
+    - ``coded_v2_straggler``: `FaultInjector.slow` names a live-but-slow
+      owner; the coded plane races owner fetch vs reconstruction and
+      the p99 sort completion with serving ON must beat the
+      wait-on-owner baseline — measured from the SAME reps as the
+      losing owner leg's own completion time (`join_stragglers` drain),
+      which pays the injected delay the serve dodged.  Exactly one
+      ``coded_straggler_serves`` per rep, no failure, no mesh re-form.
+
+    Every arm's output must be bit-identical to ``np.sort``.
+    """
+    import math
+
+    import jax
+
+    from dsort_tpu.config import JobConfig
+    from dsort_tpu.data.ingest import gen_zipf
+    from dsort_tpu.scheduler import FaultInjector, SpmdScheduler
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        raise SystemExit(
+            "--coded-v2-ab needs a multi-device mesh (there is no parity "
+            "holder on one device); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    victim = min(3, len(devices) - 1)  # replica/parity holder wraps the ring
+    slow_s = 0.5  # the injected straggler's extra owner-fetch latency
+    journal = _open_journal(args)
+    data = gen_zipf(args.n, a=1.3, seed=5)
+    expect = np.sort(data)
+    n = len(data)
+
+    def make_sched(red: int, mode: str):
+        inj = FaultInjector()
+        return inj, SpmdScheduler(
+            devices=devices,
+            job=JobConfig(
+                settle_delay_s=0.01, exchange="ring", redundancy=red,
+                redundancy_mode=mode, key_dtype=np.int64,
+                local_kernel=cfg.job.local_kernel,
+            ),
+            injector=inj,
+        )
+
+    def drain(sched) -> None:
+        for ss in sched._sorters.values():
+            ss.join_stragglers()
+
+    def p99(times: list) -> float:
+        ts = sorted(times)
+        return float(ts[max(0, math.ceil(0.99 * len(ts)) - 1)])
+
+    def run_arm(red: int, mode: str, shape: str):
+        """shape: 'healthy' | 'loss' | 'slow'.  Returns (times, owner
+        times, per-arm metrics, last output): faulted/slow reps each run
+        on a FRESH scheduler with a healthy warm pass off the clock, so
+        the timed sort pays its true recovery/serve cost."""
+        times, owner_times = [], []
+        m = Metrics(journal=journal)
+        out = None
+        if shape == "healthy":
+            _, sched = make_sched(red, mode)
+            sched.sort(data)  # warm the healthy P-device programs
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                out = sched.sort(data, metrics=m)
+                times.append(time.perf_counter() - t0)
+            return times, owner_times, m, out
+        for _ in range(args.reps):
+            inj, sched = make_sched(red, mode)
+            sched.sort(data)  # healthy warm pass, off the clock
+            if shape == "loss":
+                inj.fail_once(victim, "ring")
+            else:
+                inj.slow(victim, slow_s)
+            t0 = time.perf_counter()
+            out = sched.sort(data, metrics=m)
+            times.append(time.perf_counter() - t0)
+            # The losing owner leg is still sleeping out the injected
+            # delay; its completion time IS the wait-on-owner baseline
+            # for this rep (what the sort would have cost without the
+            # race).  Drain it before the next rep so claims stay 1/rep.
+            drain(sched)
+            owner_times.append(time.perf_counter() - t0)
+        return times, owner_times, m, out
+
+    try:
+        arms = {}
+        ok_all = True
+        for red, mode, shape in (
+            (1, "replicate", "healthy"),
+            (2, "replicate", "healthy"),
+            (2, "parity", "healthy"),
+            (2, "replicate", "loss"),
+            (2, "parity", "loss"),
+            (2, "parity", "slow"),
+        ):
+            times, owner_times, m, out = run_arm(red, mode, shape)
+            identical = bool(np.array_equal(out, expect))
+            ok_all = ok_all and identical
+            arms[(red, mode, shape)] = {
+                "dt": float(min(times)),
+                "p99": p99(times),
+                "p99_owner": p99(owner_times) if owner_times else 0.0,
+                "identical": identical,
+                "coded_recoveries": m.counters.get("coded_recoveries", 0)
+                // args.reps,
+                "recovered_keys": m.counters.get("coded_recovered_keys", 0)
+                // args.reps,
+                "replica_bytes": m.counters.get("coded_replica_bytes", 0)
+                // args.reps,
+                "straggler_serves": m.counters.get(
+                    "coded_straggler_serves", 0
+                ) // args.reps,
+                "resort_keys": m.counters.get("shuffle_resort_keys", 0),
+                "mesh_reforms": m.counters.get("mesh_reforms", 0)
+                // args.reps,
+            }
+        h0 = arms[(1, "replicate", "healthy")]
+        hr, hp = arms[(2, "replicate", "healthy")], arms[(2, "parity", "healthy")]
+        fr, fp = arms[(2, "replicate", "loss")], arms[(2, "parity", "loss")]
+        sl = arms[(2, "parity", "slow")]
+        premium = hp["replica_bytes"] / max(hr["replica_bytes"], 1)
+        # Gate 1: parity's availability premium undercuts replication.
+        ok_all = ok_all and hp["replica_bytes"] > 0 and premium < 0.75
+        # Gate 2: both modes recover the injected loss LOCALLY.
+        for f in (fr, fp):
+            ok_all = (
+                ok_all and f["coded_recoveries"] == 1
+                and f["resort_keys"] == 0
+            )
+        # Gate 3: serving beats waiting on the slow owner, exactly once,
+        # with no failure machinery involved.
+        ok_all = (
+            ok_all and sl["straggler_serves"] == 1
+            and sl["p99"] < sl["p99_owner"]
+            and sl["mesh_reforms"] == 0
+        )
+        print(json.dumps({
+            "metric": f"coded_v2_parity_premium_zipf_{args.n}",
+            "value": round(n / hp["dt"], 1),
+            "unit": "keys/sec",
+            "baseline_keys_per_sec": round(n / h0["dt"], 1),
+            "replicate_keys_per_sec": round(n / hr["dt"], 1),
+            "replica_overhead_frac": round(
+                max(hp["dt"] - h0["dt"], 0.0) / h0["dt"], 4
+            ),
+            "redundancy": 2,
+            "redundancy_mode": "parity",
+            "coded_replica_bytes": hp["replica_bytes"],
+            "replicate_replica_bytes": hr["replica_bytes"],
+            "premium_ratio": round(premium, 4),
+            "bit_identical": hp["identical"] and hr["identical"],
+        }), flush=True)
+        print(json.dumps({
+            "metric": f"coded_v2_parity_failure_zipf_{args.n}",
+            "value": round(n / fp["dt"], 1),
+            "unit": "keys/sec",
+            "baseline_keys_per_sec": round(n / h0["dt"], 1),
+            "replicate_keys_per_sec": round(n / fr["dt"], 1),
+            "throughput_under_failure_ratio": round(h0["dt"] / fp["dt"], 3),
+            "redundancy": 2,
+            "redundancy_mode": "parity",
+            "coded_recoveries": fp["coded_recoveries"],
+            "recovered_keys": fp["recovered_keys"],
+            "mesh_reforms": fp["mesh_reforms"],
+            "includes_reform_and_recompile": True,
+            "bit_identical": fp["identical"] and fr["identical"],
+        }), flush=True)
+        print(json.dumps({
+            "metric": f"coded_v2_straggler_zipf_{args.n}",
+            "value": round(n / sl["p99"], 1),
+            "unit": "keys/sec",
+            "baseline_keys_per_sec": round(n / h0["dt"], 1),
+            "p99_serve_s": round(sl["p99"], 4),
+            "p99_owner_s": round(sl["p99_owner"], 4),
+            "speedup_vs_wait": round(sl["p99_owner"] / sl["p99"], 2),
+            "slow_s": slow_s,
+            "redundancy": 2,
+            "redundancy_mode": "parity",
+            "straggler_serves": sl["straggler_serves"],
+            "mesh_reforms": sl["mesh_reforms"],
+            "bit_identical": sl["identical"],
         }), flush=True)
     finally:
         _write_journal(journal, args)
@@ -2268,6 +2484,21 @@ def cmd_bench(args) -> int:
 
     if args.reps < 1:
         raise SystemExit("--reps must be >= 1")
+    if getattr(args, "coded_v2_ab", False):
+        if args.suite or getattr(args, "device_resident", False) or getattr(
+            args, "exchange_ab", False
+        ) or getattr(args, "serve_mixed", False) or getattr(
+            args, "analyze_smoke", False
+        ) or getattr(args, "external_wave", False) or getattr(
+            args, "fleet_mixed", False
+        ) or getattr(args, "coded_ab", False) or getattr(
+            args, "autotune_ab", False
+        ) or getattr(args, "hier_ab", False):
+            raise SystemExit(
+                "--coded-v2-ab is its own benchmark: run it as a separate "
+                "invocation"
+            )
+        return _bench_coded_v2_ab(args, _load_config(args))
     if getattr(args, "hier_ab", False):
         if args.suite or getattr(args, "device_resident", False) or getattr(
             args, "exchange_ab", False
@@ -2672,6 +2903,7 @@ def cmd_external(args) -> int:
                 overlap=not getattr(args, "no_overlap", False),
                 exchange=getattr(args, "exchange", None),
                 redundancy=getattr(args, "redundancy", None),
+                redundancy_mode=getattr(args, "redundancy_mode", None),
             )
         else:
             from dsort_tpu.models.external_sort import ExternalSort
@@ -3155,6 +3387,14 @@ def main(argv=None) -> int:
                             "of replica slots — zero keys re-sorted, zero "
                             "re-dispatch (ARCHITECTURE \u00a714; forces the "
                             "lax ring schedule; conf key REDUNDANCY)")
+        p.add_argument("--redundancy-mode",
+                       choices=["replicate", "parity"],
+                       help="how r > 1 ships its premium (ARCHITECTURE "
+                            "\u00a718): 'replicate' = full bucket copies, "
+                            "(r-1)x extra wire bytes; 'parity' = XOR (r=2) "
+                            "or RAID-6 P+Q GF(256) (r>=3) parity slots \u2014 "
+                            "same local-merge recovery at ~1/P x the "
+                            "premium (conf key REDUNDANCY_MODE)")
         p.add_argument("--checkpoint-dir",
                        help="persist per-shard/range progress here; a re-run "
                             "of the same input resumes instead of re-sorting")
@@ -3353,6 +3593,15 @@ def main(argv=None) -> int:
                         "injected device loss (bit-identical gate); JSON "
                         "rows with throughput_under_failure_ratio and the "
                         "healthy-path replica overhead")
+    p.add_argument("--coded-v2-ab", action="store_true",
+                   help="coded-exchange v2 acceptance A/B (ARCHITECTURE "
+                        "§18): replicate vs parity at redundancy=2 — "
+                        "healthy wire premium (parity < 0.75x replicate's "
+                        "coded_replica_bytes), one injected loss per mode "
+                        "(both recover locally, zero re-sorted keys), and "
+                        "the straggler drill (p99 with serving ON beats "
+                        "the measured wait-on-owner baseline, exactly one "
+                        "serve per rep); bit-identical gate throughout")
     p.add_argument("--autotune-ab", action="store_true",
                    help="closed-loop planner A/B: zipf + uniform workloads "
                         "with exchange hand-set to alltoall, hand-set to "
@@ -3464,6 +3713,10 @@ def main(argv=None) -> int:
                         "(default 1 = off): a device lost mid-wave repairs "
                         "from replica slots instead of a host re-sort — "
                         "wave_runs_resorted stays 0 (ARCHITECTURE §14)")
+    p.add_argument("--redundancy-mode",
+                   choices=["replicate", "parity"],
+                   help="replica plane mode for coded waves: full copies "
+                        "or XOR/P+Q parity slots (ARCHITECTURE §18)")
     p.add_argument("--spill-dir")
     p.add_argument("--job-id", default="external")
     p.add_argument("--no-resume", action="store_true",

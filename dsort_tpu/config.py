@@ -124,6 +124,16 @@ class JobConfig:
     # replica plane rides its ppermute steps) and costs ~r x the exchange
     # wire bytes on the healthy path — the availability premium.
     redundancy: int = 1
+    # HOW the redundancy plane ships its premium (ARCHITECTURE §18):
+    # "replicate" = full bucket copies on ring successors ((r-1)x extra
+    # wire bytes, survives any r-1 losses of a range's holder set);
+    # "parity" = XOR (r=2) or RAID-6 P+Q GF(256) parity slots (r>=3) —
+    # each device keeps its own out-plane locally for free and ships ONE
+    # parity slot per parity index, so the wire premium falls from
+    # (r-1)x toward 1/P x at the same single- (XOR) / double-loss (P+Q)
+    # survivability; recovery is still a local merge (zero keys
+    # re-sorted).  Ignored at redundancy=1.
+    redundancy_mode: str = "replicate"
     # Sample-sort knobs (SURVEY.md §5.7 analogue of splitter selection):
     oversample: int = 32            # splitter candidates per device
     # Per-(src,dst) all_to_all bucket headroom over the ideal n/P split.
@@ -218,6 +228,11 @@ class JobConfig:
         if not isinstance(self.redundancy, int) or self.redundancy < 1:
             raise ConfigError(
                 f"redundancy must be an integer >= 1, got {self.redundancy!r}"
+            )
+        if self.redundancy_mode not in ("replicate", "parity"):
+            raise ConfigError(
+                "redundancy_mode must be 'replicate' or 'parity', got "
+                f"{self.redundancy_mode!r}"
             )
         if self.oversample < 1:
             raise ConfigError(f"oversample must be >= 1, got {self.oversample}")
@@ -449,7 +464,8 @@ class SortConfig:
         plus framework keys (``NUM_WORKERS``, ``KEY_DTYPE``, ``OVERSAMPLE``,
         ``CAPACITY_FACTOR``, ``PAYLOAD_BYTES``, ``HEARTBEAT_TIMEOUT_S``,
         ``OUTPUT_PATH``, ``DP``, ``CHECKPOINT_DIR``, ``EXCHANGE``,
-        ``REDUNDANCY``, ``TENANT``, ``FLIGHT_DIR``, ``AUTOTUNE`` — the
+        ``REDUNDANCY``, ``REDUNDANCY_MODE``, ``TENANT``, ``FLIGHT_DIR``,
+        ``AUTOTUNE`` — the
         closed-loop planner switch; a knob key PRESENT in the mapping is
         explicit and never planner-overridden) and serving-layer keys
         (``SERVE_QUEUE_DEPTH``, ``SERVE_TENANT_INFLIGHT``,
@@ -474,8 +490,10 @@ class SortConfig:
         _EXPLICIT_KEYS = {
             "EXCHANGE": "exchange",
             "REDUNDANCY": "redundancy",
+            "REDUNDANCY_MODE": "redundancy_mode",
             "EXTERNAL_WAVE_ELEMS": "wave_elems",
             "SERVE_PREWARM": "prewarm",
+            "SERVE_SLICE_DEVICES": "slice_devices",
             "FLEET_DISPATCH_TIMEOUT_S": "dispatch_timeout_s",
         }
         explicit = tuple(
@@ -491,6 +509,9 @@ class SortConfig:
             exchange=m.get("EXCHANGE", JobConfig.exchange),
             hier_hosts=geti("HIER_HOSTS", JobConfig.hier_hosts),
             redundancy=geti("REDUNDANCY", JobConfig.redundancy),
+            redundancy_mode=m.get(
+                "REDUNDANCY_MODE", JobConfig.redundancy_mode
+            ),
             oversample=geti("OVERSAMPLE", JobConfig.oversample),
             capacity_factor=float(
                 m.get("CAPACITY_FACTOR", JobConfig.capacity_factor)

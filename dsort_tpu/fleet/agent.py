@@ -361,9 +361,11 @@ class FleetAgent:
                             "reason": f"bad_payload: {e}"})
                 return
             red = header.get("redundancy")
+            mode = header.get("redundancy_mode")
             verdict, ticket = self.service.submit(
                 data, tenant=tenant, job_id=label,
                 redundancy=int(red) if red is not None else None,
+                redundancy_mode=str(mode) if mode is not None else None,
             )
             if not verdict.admitted:
                 self._send({"type": "rejected", "job_id": jid,

@@ -193,6 +193,7 @@ class FleetController:
         flight_dir: str | None = None,
         autotune: bool = False,
         redundancy: int | None = None,
+        redundancy_mode: str | None = None,
         start: bool = True,
     ):
         if routing not in ROUTING_POLICIES:
@@ -273,6 +274,12 @@ class FleetController:
 
         self.autotune = bool(autotune)
         self.redundancy = int(redundancy) if redundancy is not None else None
+        # The mode axis of the same policy (ARCHITECTURE §18): how a
+        # planned r > 1 ships its premium — full copies when losses are
+        # observed, parity slots when the fleet is merely degraded.
+        self.redundancy_mode = (
+            str(redundancy_mode) if redundancy_mode is not None else None
+        )
         self.planner = Planner()
         self.planner.attach(self._svc_metrics)
         self.flight = None
@@ -1047,6 +1054,31 @@ class FleetController:
             "redundancy", inputs, job.ticket.metrics,
         ))
 
+    def _plan_redundancy_mode(self, job: _Job, planned_r) -> str | None:
+        """The mode axis of the per-dispatch redundancy decision.
+
+        Returns the mode to stamp into the submit header, or None (no
+        stamp: the agent's own ``JobConfig.redundancy_mode`` applies).
+        Only consulted when the dispatch actually ships a replica plane
+        (``planned_r`` > 1) — journaling a mode decision for an uncoded
+        dispatch would be noise the replay verdict still had to satisfy.
+        """
+        if not self.autotune:
+            return self.redundancy_mode
+        if planned_r is None or int(planned_r) <= 1:
+            return self.redundancy_mode
+        inputs = self.planner.redundancy_mode_inputs(
+            scores=self.health.scores(),
+        )
+        if self.redundancy_mode is not None:
+            return str(self.planner.note_override(
+                "redundancy_mode", self.redundancy_mode, inputs,
+                job.ticket.metrics,
+            ))
+        return str(self.planner.decide(
+            "redundancy_mode", inputs, job.ticket.metrics,
+        ))
+
     def _plan_dispatch_timeout(self, job: _Job) -> float:
         """The per-dispatch SEND deadline (obs.plan's dispatch_timeout_s
         policy): p99 of the accept latencies this controller has observed,
@@ -1073,6 +1105,9 @@ class FleetController:
             meta, payload = encode_array(payload_arr)
             planned_r = self._plan_redundancy(job)
             red = {} if planned_r is None else {"redundancy": int(planned_r)}
+            planned_mode = self._plan_redundancy_mode(job, planned_r)
+            if planned_mode is not None:
+                red["redundancy_mode"] = str(planned_mode)
             t_send = time.monotonic()
             header, _ = self._request(
                 link,

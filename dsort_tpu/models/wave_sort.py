@@ -54,6 +54,7 @@ from __future__ import annotations
 import functools
 import os
 import tempfile
+import time
 
 import numpy as np
 
@@ -326,6 +327,7 @@ class ExternalWaveSort:
         axis_name: str = "w",
         exchange: str | None = None,
         redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ):
         if wave_elems < 2:
             raise ValueError("wave_elems must be >= 2")
@@ -358,6 +360,7 @@ class ExternalWaveSort:
             resolve_exchange,
             resolve_hier_hosts,
             resolve_redundancy,
+            resolve_redundancy_mode,
         )
 
         exch = resolve_exchange(exchange, self.job.exchange, self.num_workers)
@@ -386,6 +389,12 @@ class ExternalWaveSort:
         # coded wave overrides exchange="fused" back to "ring".
         self.redundancy = resolve_redundancy(
             redundancy, self.job.redundancy, self.num_workers
+        )
+        # v2 mode axis: "replicate" ships full sorted copies (r-1 x wire
+        # premium), "parity" ships XOR/GF(256) parity slots instead
+        # (~1/P the premium at the same single-loss survivability).
+        self.redundancy_mode = resolve_redundancy_mode(
+            redundancy_mode, getattr(self.job, "redundancy_mode", "replicate")
         )
         if self.redundancy > 1 and self.exchange != "ring":
             log.warning(
@@ -544,24 +553,32 @@ class ExternalWaveSort:
         return fn
 
     def _build_coded(self, n_local: int, caps: tuple):
-        """Coded per-wave exchange (`exchange._coded_ring_exchange_shard`):
-        the measured-caps ring schedule plus the replica plane, so a wave
-        surviving a device loss repairs from replica slots instead of a
-        host re-sort.  No donation — a fault needs the wave's merged ranges
-        AND replicas host-fetchable after the dispatch."""
+        """Coded per-wave exchange: the measured-caps ring schedule plus
+        the redundancy plane — replica slots
+        (`exchange._coded_ring_exchange_shard`) or XOR/GF(256) parity
+        slots (`exchange._parity_ring_exchange_shard`) by
+        ``redundancy_mode`` — so a wave surviving a device loss repairs
+        off-plane instead of a host re-sort.  No donation — a fault needs
+        the wave's merged ranges AND the plane host-fetchable after the
+        dispatch."""
         import jax
         from jax.sharding import PartitionSpec as P
 
         from dsort_tpu.obs.prof import instrument_jit
-        from dsort_tpu.parallel.exchange import _coded_ring_exchange_shard
+        from dsort_tpu.parallel.exchange import (
+            _coded_ring_exchange_shard,
+            _parity_ring_exchange_shard,
+        )
         from dsort_tpu.utils.compat import shard_map
 
-        key = (n_local, caps)
+        parity = self.redundancy_mode == "parity"
+        key = (n_local, caps, self.redundancy_mode)
         fn = self._coded_cache.get(key)
         if fn is None:
             p = self.num_workers
             body = functools.partial(
-                _coded_ring_exchange_shard,
+                _parity_ring_exchange_shard if parity
+                else _coded_ring_exchange_shard,
                 num_workers=p,
                 caps=caps,
                 axis=self.axis,
@@ -575,13 +592,14 @@ class ExternalWaveSort:
                         body,
                         mesh=self.mesh,
                         in_specs=(P(self.axis), P(self.axis), P()),
-                        out_specs=(P(self.axis),) * 5,
+                        out_specs=(P(self.axis),) * (6 if parity else 5),
                         check_vma=False,
                     ),
                 ),
                 key_fn=lambda *a: (
-                    "wave_coded", p, n_local, caps, self.redundancy,
-                    str(a[0].dtype), self.job.local_kernel,
+                    "wave_parity" if parity else "wave_coded", p, n_local,
+                    caps, self.redundancy, str(a[0].dtype),
+                    self.job.local_kernel,
                 ),
             )
             self._coded_cache[key] = fn
@@ -899,6 +917,7 @@ class ExternalWaveSort:
             note_coded_plan(
                 metrics, caps, hist_h, n_local, p, shards.dtype.itemsize,
                 self.job.capacity_factor, self.redundancy,
+                mode=self.redundancy_mode,
             )
         elif hier:
             from dsort_tpu.parallel.exchange import hier_plan, note_hier_plan
@@ -919,9 +938,8 @@ class ExternalWaveSort:
         with timer.phase("wave_exchange"):
             if coded:
                 codedfn = self._build_coded(n_local, caps)
-                merged, cnts, overflow, reps, rep_lens = codedfn(
-                    xs_sorted, cj, spl
-                )
+                outs = codedfn(xs_sorted, cj, spl)
+                merged, cnts, overflow = outs[:3]
             elif hier:
                 hierfn = self._build_hier(n_local, hplan)
                 merged, _, overflow = hierfn(xs_sorted, cj, spl)
@@ -937,14 +955,21 @@ class ExternalWaveSort:
             try:
                 self.fault_hook()
             except WorkerFailure as e:
-                # Replica placement completed with the exchange: snapshot
-                # what the survivors hold so the wave repairs from replica
-                # slots (no host re-sort) — `_coded_recover_wave`.
-                from dsort_tpu.parallel.coded import snapshot_state
+                # Plane placement completed with the exchange: snapshot
+                # what the survivors hold so the wave repairs from the
+                # replica/parity plane (no host re-sort) —
+                # `_coded_recover_wave`.
+                from dsort_tpu.parallel.coded import (
+                    snapshot_parity_state,
+                    snapshot_state,
+                )
 
-                e.coded_state = snapshot_state(
-                    p, self.redundancy, caps, int(hist_h.sum()),
-                    merged, cnts, overflow, reps, rep_lens,
+                snap = (
+                    snapshot_parity_state
+                    if self.redundancy_mode == "parity" else snapshot_state
+                )
+                e.coded_state = snap(
+                    p, self.redundancy, caps, int(hist_h.sum()), *outs
                 )
                 raise
         # Keys landing on each range this wave — derived from the already
@@ -1036,9 +1061,9 @@ class ExternalWaveSort:
         metrics.bump("runs_sorted", p)
         metrics.event("wave_done", wave=w, runs=p, n_keys=total)
         log.warning(
-            "wave %d repaired CODED: %d key(s) of %d dead range(s) merged "
-            "from replica slots — zero runs re-sorted",
-            w, info["recovered_keys"], len(positions),
+            "wave %d repaired CODED: %d key(s) of %d dead range(s) "
+            "recovered from the %s plane — zero runs re-sorted",
+            w, info["recovered_keys"], len(positions), state.mode,
         )
         _die_check(w)
         return True
@@ -1089,6 +1114,8 @@ class ExternalWaveTeraSort:
         axis_name: str = "w",
         job: JobConfig | None = None,
         exchange: str | None = None,
+        redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ):
         if wave_recs < 2:
             raise ValueError("wave_recs must be >= 2")
@@ -1127,7 +1154,11 @@ class ExternalWaveTeraSort:
         # is validated and recorded but warns that no device schedule
         # exists to select here; a silently-dropped knob would misstate
         # the wire posture (same doctrine as `cmd_external`'s warnings).
-        from dsort_tpu.parallel.exchange import resolve_exchange
+        from dsort_tpu.parallel.exchange import (
+            resolve_exchange,
+            resolve_redundancy,
+            resolve_redundancy_mode,
+        )
 
         self.exchange = resolve_exchange(
             exchange, self.job.exchange, self.num_workers
@@ -1139,6 +1170,22 @@ class ExternalWaveTeraSort:
                 "here yet — see ARCHITECTURE §17 for the planned kv hier "
                 "leg", self.exchange,
             )
+        # Record-wave redundancy (v2, ARCHITECTURE §18): the exchange is
+        # host-side, so the redundancy "plane" here is the RETAINED host
+        # fetch of each wave's sorted shards — the D2H the host-side split
+        # needs anyway, pulled BEFORE the fault seam.  Zero wire premium
+        # (honestly: there is no device exchange to protect); a device
+        # loss after the wave's mesh sort completes retires the wave from
+        # the retained copy — ``wave_runs_resorted`` stays 0, exactly the
+        # coded contract the key pipeline gives.  ``redundancy_mode`` is
+        # accepted for API symmetry and recorded, but selects no extra
+        # encoding: retention already costs less wire than either mode.
+        self.redundancy = resolve_redundancy(
+            redundancy, self.job.redundancy, self.num_workers
+        )
+        self.redundancy_mode = resolve_redundancy_mode(
+            redundancy_mode, getattr(self.job, "redundancy_mode", "replicate")
+        )
         self.fault_hook = None
         self._sort_cache: dict = {}
 
@@ -1257,7 +1304,21 @@ class ExternalWaveTeraSort:
         def dispatch(w, chunk):
             recs, shards = chunk
             metrics.event("wave_start", wave=w, n_keys=len(recs))
-            return self._dispatch_wave(shards, metrics, timer)
+            try:
+                return self._dispatch_wave(shards, metrics, timer)
+            except Exception as e:  # noqa: BLE001 — coded seam, then repair
+                # A loss in a CODED record wave carries the retained host
+                # shards: the wave retires from them right here — zero
+                # runs re-sorted — and the pipeline moves on (state None
+                # skips retire).  An uncoded loss falls through to the
+                # host re-sort repair path.
+                state = getattr(e, "wave_record_state", None)
+                if state is not None:
+                    self._coded_recover_wave(
+                        w, e, state, splitters, ckpt, metrics, timer
+                    )
+                    return None
+                raise
 
         def retire(w, chunk, state, save):
             self._retire_wave(w, state, splitters, ckpt, metrics, timer, save)
@@ -1318,9 +1379,26 @@ class ExternalWaveTeraSort:
             )
             sorted_recs = fn(xk1, xk2, xrv, cj)
         LEDGER.drain_to(metrics)
+        retained = None
+        if self.redundancy > 1:
+            # The redundancy plane of the host-side record exchange: pull
+            # the sorted shards D2H — the fetch `_retire_wave` needs
+            # anyway — BEFORE the fault seam, so a device loss past this
+            # point cannot take the wave's work with it.
+            with timer.phase("wave_spill"):
+                retained = np.asarray(jax.device_get(sorted_recs)).reshape(
+                    self.num_workers, -1, self.RECORD_BYTES
+                )
         if self.fault_hook is not None:
-            self.fault_hook()
-        return sorted_recs, counts
+            from dsort_tpu.scheduler.fault import WorkerFailure
+
+            try:
+                self.fault_hook()
+            except WorkerFailure as e:
+                if retained is not None:
+                    e.wave_record_state = (retained, counts)
+                raise
+        return (retained if retained is not None else sorted_recs), counts
 
     def _retire_wave(
         self, w, state, splitters, ckpt, metrics, timer, save
@@ -1380,6 +1458,50 @@ class ExternalWaveTeraSort:
             return out
         order = np.lexsort((np.concatenate(k2s), np.concatenate(k1s)))
         return np.concatenate(subs)[order]
+
+    def _coded_recover_wave(
+        self, w, exc, state, splitters, ckpt, metrics, timer
+    ) -> None:
+        """Complete record wave ``w`` from the retained host shards.
+
+        The wave's sorted shards were fetched D2H before the loss
+        surfaced (`_dispatch_wave`), so the normal host-side retire —
+        split at the fixed splitters + heap merge — runs unchanged on the
+        retained copy: ``wave_runs_resorted`` stays 0 and the journal
+        carries the same ``coded_recover`` accounting as the key
+        pipeline's replica-plane repair (``replica_bytes=0`` — retention
+        ships nothing extra)."""
+        from dsort_tpu.parallel.coded import dead_positions
+
+        t0 = time.monotonic()
+        positions = sorted(set(dead_positions(exc)))
+        per_range: dict[int, int] = {}
+
+        def save(f, w_, r, run):
+            per_range[r] = len(run)
+            f(w_, r, run)
+
+        self._retire_wave(w, state, splitters, ckpt, metrics, timer, save)
+        recovered = sum(per_range.get(d, 0) for d in positions)
+        metrics.bump("coded_recoveries")
+        metrics.bump("coded_recovered_keys", recovered)
+        metrics.event(
+            "coded_recover",
+            dead=positions,
+            holders={},
+            recovered_keys=recovered,
+            replica_bytes=0,
+            redundancy=self.redundancy,
+            mode="retain",
+            wall_s=round(time.monotonic() - t0, 6),
+            wave=w,
+        )
+        log.warning(
+            "record wave %d repaired CODED: %d record(s) of %d dead "
+            "range(s) retired from retained host shards — zero runs "
+            "re-sorted", w, recovered, len(positions),
+        )
+        _die_check(w)
 
     def _repair_wave(
         self, recs, w, missing, splitters, ckpt, metrics, reason

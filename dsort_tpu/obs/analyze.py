@@ -116,10 +116,14 @@ def analyze_records(
     skew_best: dict | None = None
     hbm_best: dict | None = None
     coded_recoveries = 0
+    parity_recoveries = 0
     coded_keys = 0
     coded_replica_bytes = 0
     coded_wall_s = 0.0
     coded_budget_exceeded = 0
+    straggler_serves = 0
+    straggler_serve_keys = 0
+    straggler_wall_s = 0.0
     mesh_reforms = 0
     evictions = 0
     wave_start: dict[tuple[int, object], float] = {}
@@ -169,14 +173,27 @@ def analyze_records(
                     k: v for k, v in r.items()
                     if k not in ("seq", "t", "mono", "type")
                 }
-        elif etype == "coded_recover":
-            coded_recoveries += 1
+        elif etype in ("coded_recover", "parity_recover"):
+            # Both are coded-local reconstructions (ARCHITECTURE §14/§18);
+            # parity solves are tallied apart so the verdict can say WHICH
+            # premium (full replicas vs XOR/P+Q slots) paid for recovery.
+            if etype == "parity_recover":
+                parity_recoveries += 1
+            else:
+                coded_recoveries += 1
             coded_keys += int(r.get("recovered_keys", 0) or 0)
             coded_replica_bytes += int(r.get("replica_bytes", 0) or 0)
             w = r.get("wall_s")
             coded_wall_s += float(w) if isinstance(w, (int, float)) else 0.0
         elif etype == "coded_budget_exceeded":
             coded_budget_exceeded += 1
+        elif etype == "coded_straggler_serve":
+            straggler_serves += 1
+            straggler_serve_keys += int(r.get("recovered_keys", 0) or 0)
+            w = r.get("wall_s")
+            straggler_wall_s += (
+                float(w) if isinstance(w, (int, float)) else 0.0
+            )
         elif etype == "mesh_reform":
             mesh_reforms += 1
         elif etype == "job_evicted":
@@ -302,34 +319,53 @@ def analyze_records(
         )
     )
     recovery = None
+    local_recoveries = coded_recoveries + parity_recoveries
     if (
-        coded_recoveries or coded_budget_exceeded or mesh_reforms
-        or evictions or resorted_keys
+        local_recoveries or coded_budget_exceeded or mesh_reforms
+        or evictions or resorted_keys or straggler_serves
     ):
         # A coded recovery re-forms exactly once per loss, so reforms in
         # EXCESS of the coded recoveries — like resume-path re-sorts,
         # budget overruns, or evictions that never completed codedly —
-        # mean a re-run recovery also happened this session.
+        # mean a re-run recovery also happened this session.  Parity
+        # solves count the same as replica merges here (both are
+        # coded-local, §18); straggler serves inject NO failure and so
+        # never imply a re-run on their own.
         rerun_like = (
             coded_budget_exceeded > 0
             or resorted_keys > 0
-            or mesh_reforms > coded_recoveries
-            or (evictions > 0 and coded_recoveries == 0)
+            or mesh_reforms > local_recoveries
+            or (evictions > 0 and local_recoveries == 0)
         )
-        if coded_recoveries and rerun_like:
+        if local_recoveries and rerun_like:
             path = "mixed"
+        elif parity_recoveries and coded_recoveries:
+            path = "mixed"
+        elif parity_recoveries:
+            path = "parity_reconstruct"
         elif coded_recoveries:
             path = "coded_reconstruct"
+        elif straggler_serves and not (
+            mesh_reforms or evictions or resorted_keys
+            or coded_budget_exceeded
+        ):
+            path = "straggler_serve"
         else:
             path = "rerun"
         recovery = {
             "path": path,
             "coded": {
                 "recoveries": coded_recoveries,
+                "parity_recoveries": parity_recoveries,
                 "recovered_keys": coded_keys,
                 "replica_bytes": coded_replica_bytes,
                 "wall_s": round(coded_wall_s, 6),
                 "budget_exceeded": coded_budget_exceeded,
+            },
+            "straggler": {
+                "serves": straggler_serves,
+                "served_keys": straggler_serve_keys,
+                "wall_s": round(straggler_wall_s, 6),
             },
             "rerun": {
                 "mesh_reforms": mesh_reforms,

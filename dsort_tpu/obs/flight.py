@@ -53,6 +53,7 @@ RECOVERY_EVENTS = frozenset(
         "transient_retry",           # in-place retry on a healthy mesh
         "job_evicted",               # serving layer evicted a job off a slice
         "coded_recover",             # dead range rebuilt from replica slots
+        "parity_recover",            # dead range solved from XOR/P+Q parity
     }
 )
 
@@ -101,6 +102,12 @@ def recovery_path_name(etype: str, fields: dict) -> str:
         # The coded plane's bundle name (ARCHITECTURE §14): the recovery
         # was a local reconstruction from replica slots, not a re-run.
         return "coded_reconstruct"
+    if etype == "parity_recover":
+        # The v2 parity plane (§18): same local posture, but the lost
+        # range was SOLVED from XOR/P+Q slots rather than merged from a
+        # full replica — named apart so postmortems show which premium
+        # actually paid for the recovery.
+        return "parity_reconstruct"
     return etype
 
 

@@ -418,6 +418,26 @@ class HealthAnalyzer:
         }
 
 
+def straggler_position(analyzer: HealthAnalyzer, agents) -> int | None:
+    """Mesh POSITION of the degraded straggler among ``agents``, or None.
+
+    The production binding for `SampleSort.straggler_fn` (ARCHITECTURE
+    §18): ``agents`` is the attempt's agent ids in mesh-position order,
+    and only a verdict that is BOTH the fleet straggler argmax AND
+    degraded names a position — a merely-slowest-of-a-healthy-fleet
+    agent never triggers the serve race, matching the routing penalty's
+    own gate (`scores`).  Fault drills bind `FaultInjector.straggler`
+    through the same seam instead, so tests exercise the identical
+    race path a measured verdict would take.
+    """
+    verdicts = analyzer.verdicts()
+    for pos, aid in enumerate(agents):
+        v = verdicts.get(str(aid))
+        if v is not None and v["straggler"] and v["degraded"]:
+            return pos
+    return None
+
+
 def health_table(rows: dict[str, dict], indent: str = "") -> list[str]:
     """THE health-pane table — one copy of the columns, shared by the
     verdict-side renderer below and the scrape-side ``dsort top`` pane

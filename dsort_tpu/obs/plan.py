@@ -42,7 +42,8 @@ import numpy as np
 
 #: The policy catalog — one entry per knob the planner may fill.
 PLAN_POLICIES = (
-    "exchange", "wave_elems", "redundancy", "prewarm", "dispatch_timeout_s",
+    "exchange", "wave_elems", "redundancy", "redundancy_mode", "prewarm",
+    "dispatch_timeout_s", "slice_devices",
 )
 
 #: Fields every ``plan_decision`` event carries (schema, test-enforced).
@@ -70,6 +71,11 @@ WAVE_MIN_ELEMS = 1 << 18
 WAVE_MAX_ELEMS = 1 << 26
 #: Degraded-agent fraction at or above which the fleet buys a replica.
 REDUNDANCY_DEGRADED_FRAC = 0.25
+#: Post-exchange keys per device a small-job slice should stay under —
+#: above it a wider slice spreads the merge; the slice_devices policy
+#: picks the smallest power-of-two device count meeting it at the
+#: admission mix's p90 rung.
+SLICE_KEYS_PER_DEVICE = 1 << 20
 #: Admissions remembered for the prewarm rung x dtype mix.
 PREWARM_HISTORY = 64
 #: Headroom multiplier over the observed p99 dispatch-accept latency: the
@@ -291,6 +297,93 @@ def _decide_redundancy(inputs: dict) -> tuple[int, list[dict]]:
     ]
 
 
+def _decide_redundancy_mode(inputs: dict) -> tuple[str, list[dict]]:
+    """HOW a bought replica plane ships its premium (ARCHITECTURE §18).
+
+    Deliberately a SEPARATE pure policy from `_decide_redundancy` (whose
+    journaled decisions must keep replaying bit-identically): the r
+    policy answers "buy availability at all?"; this one answers "full
+    copies or parity slots?".  Observed LOSSES argue for full copies —
+    replicate recovery needs no parity solve and tolerates a holder-set
+    loss shape parity's budget might not — while a merely DEGRADED fleet
+    (slow-but-alive agents, the straggler-serve case) gets parity's near
+    1/P x wire premium at the same single-loss survivability.
+    """
+    agents = int(inputs.get("agents", 0))
+    degraded = int(inputs.get("degraded", 0))
+    losses = int(inputs.get("loss_events", 0))
+    frac = degraded / agents if agents > 0 else 0.0
+    if losses > 0:
+        return "replicate", [
+            {"value": "parity",
+             "reason": f"{losses} observed loss event(s): full copies "
+                       "recover any r-1 holder losses without a parity "
+                       "solve or its erasure-budget shape limits"},
+        ]
+    if agents > 0 and frac >= REDUNDANCY_DEGRADED_FRAC:
+        return "parity", [
+            {"value": "replicate",
+             "reason": f"{degraded}/{agents} agent(s) degraded but zero "
+                       "losses: parity buys the same single-loss cover "
+                       "(and the straggler-serve race) at ~1/P x the "
+                       "(r-1)x replica wire premium"},
+        ]
+    return "replicate", [
+        {"value": "parity",
+         "reason": f"healthy fleet ({degraded}/{agents} degraded, "
+                   f"{losses} losses): nothing to optimize; the default "
+                   "mode keeps recovery solve-free"},
+    ]
+
+
+def _decide_slice_devices(inputs: dict) -> tuple[int, list[dict]]:
+    """Devices per small-job serving slice, sized from the admission mix.
+
+    The serving layer's slice width was a hand-set flag
+    (``SERVE_SLICE_DEVICES``); this policy picks the smallest
+    power-of-two divisor of the device count whose per-device share of
+    the admission mix's p90 rung stays under `SLICE_KEYS_PER_DEVICE` —
+    small jobs keep 1-device slices (maximum packing parallelism),
+    a heavier mix widens the slice before the merge phase saturates a
+    single chip.
+    """
+    ndev = int(inputs.get("num_devices", 1))
+    cur = int(inputs.get("current", 1))
+    rungs = [int(r) for r in inputs.get("rungs", ())]
+    if ndev < 1:
+        ndev = 1
+    widths = [w for w in (1, 2, 4, 8, 16, 32, 64)
+              if w <= ndev and ndev % w == 0]
+    if not rungs:
+        return cur, [
+            {"value": "resize",
+             "reason": "no admissions observed: keeping slice_devices"},
+        ]
+    p90 = int(np.percentile(rungs, 90))
+    chosen = widths[-1]
+    for w in widths:
+        if p90 / w <= SLICE_KEYS_PER_DEVICE:
+            chosen = w
+            break
+    rejected = []
+    for w in widths:
+        if w < chosen:
+            rejected.append(
+                {"value": w,
+                 "reason": f"p90 admission rung {p90} keys / {w} device(s)"
+                           f" = {p90 // w} > {SLICE_KEYS_PER_DEVICE} "
+                           "keys/device: the merge phase saturates"})
+        elif w > chosen:
+            rejected.append(
+                {"value": w,
+                 "reason": f"p90 admission rung {p90} fits {chosen} "
+                           "device(s); a wider slice halves the packing "
+                           "parallelism for no merge relief"})
+    if chosen != cur:
+        rejected.append({"value": cur, "reason": "resized to the mix"})
+    return chosen, rejected
+
+
 def _decide_prewarm(inputs: dict) -> tuple[list, list[dict]]:
     history = [str(h) for h in inputs.get("history", ())]
     ladder = [int(r) for r in inputs.get("ladder", ())]
@@ -349,8 +442,10 @@ _POLICY_FNS = {
     "exchange": _decide_exchange,
     "wave_elems": _decide_wave_elems,
     "redundancy": _decide_redundancy,
+    "redundancy_mode": _decide_redundancy_mode,
     "prewarm": _decide_prewarm,
     "dispatch_timeout_s": _decide_dispatch_timeout_s,
+    "slice_devices": _decide_slice_devices,
 }
 
 
@@ -566,6 +661,23 @@ class Planner:
             "current": int(current),
         }
 
+    def redundancy_mode_inputs(self, scores: dict | None = None) -> dict:
+        """Same fleet-health signal as `redundancy_inputs`, minus the
+        integer ``current`` (the mode axis has no resize semantics)."""
+        inputs = self.redundancy_inputs(scores=scores)
+        inputs.pop("current", None)
+        return inputs
+
+    def slice_inputs(self, current: int, num_devices: int) -> dict:
+        st = self.state_dict()
+        return {
+            "current": int(current),
+            "num_devices": int(num_devices),
+            # Admission labels are "rung:dtype" (variant_key_label);
+            # the slice policy sizes on the rung alone.
+            "rungs": [int(lbl.split(":", 1)[0]) for lbl in st["admissions"]],
+        }
+
     # -- snapshots ----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -627,6 +739,29 @@ def planned_wave_elems(job, current: int, itemsize: int, records=(),
             "wave_elems", int(current), inputs, metrics
         ))
     return int(planner.decide("wave_elems", inputs, metrics))
+
+
+def planned_slice_devices(job, serve, current: int, num_devices: int,
+                          records=(), metrics=None) -> int:
+    """The `serve.SortService` slice-width autotune seam (mirrors
+    `planned_wave_elems`): size the small-job mesh sub-slice from the
+    journaled admission mix instead of the hand-set
+    ``SERVE_SLICE_DEVICES``.  Returns the slice width to use; the
+    explicit flag/conf key wins with a journaled ``plan_override``.
+    """
+    if job is None or not getattr(job, "autotune", False):
+        return int(current)
+    planner = Planner.replay(records, job=job)
+    inputs = planner.slice_inputs(current, num_devices)
+    explicit = (
+        "slice_devices" in getattr(job, "explicit", ())
+        or (serve is not None and "slice_devices" in getattr(serve, "explicit", ()))
+    )
+    if explicit:
+        return int(planner.note_override(
+            "slice_devices", int(current), inputs, metrics
+        ))
+    return int(planner.decide("slice_devices", inputs, metrics))
 
 
 # -- shared renderer (dsort top planner pane / report) -----------------------

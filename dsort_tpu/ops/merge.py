@@ -61,6 +61,43 @@ def merge_sorted_host(chunks: list[np.ndarray]) -> np.ndarray:
     return runs[0]
 
 
+def merge_sorted_host_kv(
+    key_runs: list[np.ndarray], val_runs: list[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray]:
+    """Stable k-way merge of sorted (key, payload-rows) run pairs.
+
+    The kv twin of `merge_sorted_host` for the coded recovery path:
+    pairwise two-way merges where each side's output positions come from
+    one vectorized ``searchsorted`` against the other (``left`` for the
+    first run, ``right`` for the second — earlier runs win ties, so the
+    reduction is stable in run order); payload rows ride the same
+    scatter, never compared.  O(N log k) total, no re-sort.
+    """
+    runs = [
+        (np.asarray(k), np.asarray(v))
+        for k, v in zip(key_runs, val_runs) if len(k)
+    ]
+    if not runs:
+        k0 = np.asarray(key_runs[0]) if key_runs else np.empty(0, np.int32)
+        v0 = np.asarray(val_runs[0]) if val_runs else np.empty(0, np.int32)
+        return k0[:0].copy(), v0[:0].copy()
+    while len(runs) > 1:
+        nxt = []
+        for i in range(0, len(runs) - 1, 2):
+            (ka, va), (kb, vb) = runs[i], runs[i + 1]
+            pa = np.arange(len(ka)) + np.searchsorted(kb, ka, side="left")
+            pb = np.arange(len(kb)) + np.searchsorted(ka, kb, side="right")
+            out_k = np.empty(len(ka) + len(kb), ka.dtype)
+            out_v = np.empty((len(ka) + len(kb),) + va.shape[1:], va.dtype)
+            out_k[pa], out_k[pb] = ka, kb
+            out_v[pa], out_v[pb] = va, vb
+            nxt.append((out_k, out_v))
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return runs[0]
+
+
 def merge_sorted_host_streaming(chunks: list[np.ndarray]):
     """Generator form (true heapq k-way) for bounded-memory egress."""
     return heapq.merge(*[iter(c) for c in chunks])

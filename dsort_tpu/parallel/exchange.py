@@ -68,6 +68,8 @@ __all__ = [
     "ring_dcn_bytes",
     "alltoall_wire_bytes",
     "replica_wire_bytes",
+    "parity_wire_bytes",
+    "parity_slots",
     "dispatches_per_exchange",
     "note_ring_plan",
     "note_fused_plan",
@@ -76,6 +78,7 @@ __all__ = [
     "note_alltoall_attempt",
     "resolve_exchange",
     "resolve_redundancy",
+    "resolve_redundancy_mode",
     "resolve_hier_hosts",
     "check_ring_overflow",
     "skew_stats",
@@ -152,6 +155,31 @@ def resolve_redundancy(value: int | None, default: int, num_workers: int) -> int
     if int(red) != red or red < 1:
         raise ValueError(f"redundancy must be an integer >= 1, got {red!r}")
     return min(int(red), max(int(num_workers), 1))
+
+
+def resolve_redundancy_mode(value: str | None, default: str) -> str:
+    """THE redundancy-MODE resolver (coded exchange v2): per-call override >
+    config default.  ``"replicate"`` is the v1 plane — full bucket copies to
+    ``r-1`` ring successors, an ``(r-1)x`` wire premium.  ``"parity"`` ships
+    XOR (``r == 2``) or Reed-Solomon-over-GF(256) RAID-6 P+Q (``r >= 3``)
+    parity of each device's out-bucket group instead, cutting the premium
+    to ``npar`` max-cap slots per device while keeping the same
+    survivability budget (`parity_slots` losses) and the same
+    ``reconstruct(dead)`` local-merge contract (`parallel.coded`)."""
+    mode = value if value is not None else default
+    if mode not in ("replicate", "parity"):
+        raise ValueError(
+            f"redundancy_mode must be 'replicate' or 'parity', got {mode!r}"
+        )
+    return mode
+
+
+def parity_slots(redundancy: int) -> int:
+    """Parity slots the parity plane ships per device: one XOR slot covers
+    the ``r=2`` single-loss budget; ``r >= 3`` caps at the RAID-6 pair
+    (P+Q), whose two-erasure solve is the deepest this plane implements —
+    requesting more redundancy than that still buys double-loss cover."""
+    return min(max(int(redundancy) - 1, 0), 2)
 
 
 def dispatches_per_exchange(exchange: str, num_workers: int) -> int:
@@ -412,9 +440,23 @@ def replica_wire_bytes(
     return int(total * bytes_per_slot * p)
 
 
+def parity_wire_bytes(
+    caps, bytes_per_slot: int, num_workers: int, redundancy: int
+) -> int:
+    """Bytes the PARITY plane adds to the wire (whole mesh): every device
+    ships ``parity_slots(r)`` byte-folded slots, each sized at the group's
+    max-cap bucket (parity folds the P out-buckets extended to a common
+    length), to its ring successors — the whole premium, vs the replicate
+    plane's per-bucket re-shipments (`replica_wire_bytes`)."""
+    return int(
+        parity_slots(redundancy) * max(caps) * bytes_per_slot * num_workers
+    )
+
+
 def note_coded_plan(
     metrics, caps, hist, n_local: int, num_workers: int, bytes_per_slot: int,
     capacity_factor: float, redundancy: int, jobs: int = 1,
+    mode: str = "replicate",
 ) -> None:
     """Journal one planned CODED ring schedule (`parallel.coded`).
 
@@ -422,24 +464,33 @@ def note_coded_plan(
     shared accounting (`note_ring_plan`: ``skew_report``, ``exchange_step``,
     the wire/saved counters) rides unchanged — plus the replica plane:
     every bucket additionally ships to its destination's ``r-1`` ring
-    successors, priced at the SAME per-step caps.  Replica traffic charges
+    successors (``mode="replicate"``) or each device ships its
+    ``parity_slots(r)`` folded parity slots (``mode="parity"``), priced at
+    the SAME per-step caps.  Redundancy traffic charges
     ``exchange_bytes_on_wire`` (it crosses the links like any shipment) AND
     the dedicated ``coded_replica_bytes`` counter, and one
     ``coded_replica_ship`` event records the plane's shape so the analyzer
-    can split replica overhead from primary exchange traffic.
+    can split redundancy overhead from primary exchange traffic — the
+    counter is the A/B axis the parity mode exists to shrink.
     """
     p = num_workers
     note_ring_plan(
         metrics, caps, hist, n_local, p, bytes_per_slot, capacity_factor,
         jobs=jobs,
     )
-    rb = replica_wire_bytes(caps, bytes_per_slot, p, redundancy) * jobs
+    if mode == "parity":
+        rb = parity_wire_bytes(caps, bytes_per_slot, p, redundancy) * jobs
+        slots = parity_slots(redundancy) * p
+    else:
+        rb = replica_wire_bytes(caps, bytes_per_slot, p, redundancy) * jobs
+        slots = (redundancy - 1) * p
     metrics.bump("exchange_bytes_on_wire", rb)
     metrics.bump("coded_replica_bytes", rb)
     metrics.event(
         "coded_replica_ship",
         redundancy=redundancy,
-        slots=(redundancy - 1) * p,
+        mode=mode,
+        slots=slots,
         bytes=rb,
     )
 
@@ -896,6 +947,94 @@ def _coded_ring_exchange_shard(
     )
 
 
+def _gf2mul_u8(x):
+    """GF(256) multiply-by-the-generator (g = 2, polynomial 0x11D) on a
+    uint8 array: shift left, fold the overflow bit back through 0x1D —
+    the device half of the RAID-6 Q-parity Horner fold; the host solver
+    (`parallel.coded`) uses the matching log/exp tables."""
+    return ((x << 1) & jnp.uint8(0xFF)) ^ (jnp.uint8(0x1D) * (x >> 7))
+
+
+def _byte_plane(x):
+    """Flatten any-dtype array to its raw byte vector (platform byte
+    order) — parity folds in GF(256) byte space, so the plane is dtype-
+    agnostic and NaN payloads / sentinel-valued keys round-trip
+    bit-identically.  The host twin is ``np.ascontiguousarray(a).view
+    (np.uint8)`` (`coded._byte_row`); both sides run on the same
+    platform, so the orders agree."""
+    return jax.lax.bitcast_convert_type(x, jnp.uint8).reshape(-1)
+
+
+def _parity_fold(rows_bytes, npar: int):
+    """The parity slots of one out-bucket group: slot 0 is the XOR fold
+    (RAID P), slot 1 the GF(256) Horner fold ``sum g^k d_k`` (RAID Q) —
+    enough to solve any ``npar`` erasures at known positions."""
+    xor = rows_bytes[0]
+    for r in rows_bytes[1:]:
+        xor = xor ^ r
+    slots = [xor]
+    if npar >= 2:
+        q = jnp.zeros_like(rows_bytes[0])
+        for r in reversed(rows_bytes):
+            q = _gf2mul_u8(q) ^ r
+        slots.append(q)
+    return slots
+
+
+def _parity_ring_exchange_shard(
+    xs, count, splitters, *, num_workers, caps, axis, redundancy,
+    merge_kernel="auto", kernel="lax",
+):
+    """Parity-coded exchange phase, keys only (coded exchange v2).
+
+    The measured-caps ring schedule of `_ring_exchange_shard` PLUS the
+    parity plane: instead of re-shipping full bucket copies, device ``m``
+    (a) RETAINS its own out-bucket plane — slot ``k`` is the sorted
+    sentinel-padded bucket toward range ``(m+k) % P``, zero wire cost
+    (its receiver holds the delivered copy too, so the bucket survives
+    unless BOTH endpoints die — the availability rule `parallel.coded`
+    reconstructs under), and (b) folds those ``P`` buckets, each extended
+    to the max cap, into ``parity_slots(r)`` GF(256) byte-space parity
+    slots shipped to its ring successors ``m+1 .. m+npar`` — the ONLY
+    redundancy wire traffic.  A dead device's group then has exactly
+    ``|dead|`` unknown buckets (its own slot plus one per dead receiver),
+    solvable while ``|dead| <= npar`` and every parity holder survives.
+
+    Returns ``(merged, out_count, overflow, sent, sent_lens, parity)``:
+    ``sent`` is the retained out plane ``(sum(caps),)`` (slot ``k`` at the
+    caps-cumsum offset), ``sent_lens`` the ``(P,)`` valid lengths, and
+    ``parity`` the ``(npar, max(caps) * itemsize)`` uint8 RECEIVED plane —
+    row ``j`` holds parity slot ``j`` of predecessor ``(m-1-j) % P``.
+    """
+    p = num_workers
+    npar = parity_slots(redundancy)
+    merged, out_count, overflow = _ring_exchange_shard(
+        xs, count, splitters, num_workers=p, caps=caps, axis=axis,
+        merge_kernel=merge_kernel, kernel=kernel,
+    )
+    c = count[0]
+    me = jax.lax.axis_index(axis)
+    starts, lens = _bucket_bounds(xs, c, splitters)
+    cap_max = int(max(caps))
+    sent_runs, sent_lens, rows_bytes = [], [], []
+    sent = sentinel_for(xs.dtype)
+    for k in range(p):
+        row = (me + jnp.int32(k)) % p
+        blk, _, _ = _bucket_gather(xs, starts, lens, row, caps[k])
+        sent_runs.append(blk)
+        sent_lens.append(lens[row])
+        rows_bytes.append(_byte_plane(_pad_run(blk, cap_max, sent)))
+    recvs = []
+    for j, slot in enumerate(_parity_fold(rows_bytes, npar)):
+        recvs.append(jax.lax.ppermute(slot, axis, _ring_perm(p, j + 1)))
+    return (
+        merged, out_count, overflow,
+        jnp.concatenate(sent_runs),
+        jnp.stack(sent_lens).astype(jnp.int32),
+        jnp.stack(recvs),
+    )
+
+
 def _ring_exchange_kv_shard(
     keys, payload, count, splitters, *, num_workers, caps, axis,
     merge_kernel="auto", kernel="lax",
@@ -975,6 +1114,120 @@ def _ring_exchange_kv_shard(
     gather = jnp.where(merged_t < total, merged_t, 0)
     out_v = _apply_perm(flat_v, gather, 0)
     return merged_k, out_v, out_count[None], overflow[None]
+
+
+def _coded_ring_exchange_kv_shard(
+    keys, payload, count, splitters, *, num_workers, caps, axis, redundancy,
+    merge_kernel="auto", kernel="lax",
+):
+    """Coded kv exchange phase: `_ring_exchange_kv_shard` PLUS the replica
+    plane covering BOTH planes — each replica shift re-ships a bucket's
+    keys AND its payload rows to the destination's ring successors, the
+    same slot layout as `_coded_ring_exchange_shard`, so kv jobs get the
+    identical local-merge recovery contract keys-only jobs have had since
+    PR 15 (the "kv runs uncoded" downgrade is gone).
+
+    Returns ``(merged_k, out_v, out_count, overflow, reps_k, reps_v,
+    rep_lens)``: ``reps_k`` is ``(r-1, sum(caps))``, ``reps_v``
+    ``(r-1, sum(caps), *trailing)`` (rows beyond a slot's valid length are
+    clip-gather residue, trimmed by ``rep_lens`` at reconstruction),
+    ``rep_lens`` ``(r-1, P)``.
+    """
+    p = num_workers
+    merged_k, out_v, out_count, overflow = _ring_exchange_kv_shard(
+        keys, payload, count, splitters, num_workers=p, caps=caps,
+        axis=axis, merge_kernel=merge_kernel, kernel=kernel,
+    )
+    c = count[0]
+    me = jax.lax.axis_index(axis)
+    starts, lens = _bucket_bounds(keys, c, splitters)
+    reps_k, reps_v, rep_lens = [], [], []
+    for j in range(1, redundancy):
+        runs_k, runs_v, rlens = [], [], []
+        for k in range(p):
+            row = (me + jnp.int32(k)) % p
+            blk, idx, _ = _bucket_gather(keys, starts, lens, row, caps[k])
+            pv = payload[idx]
+            shift = (k + j) % p
+            if shift == 0:
+                recv_k, recv_v, recv_len = blk, pv, lens[row]
+            else:
+                perm = _ring_perm(p, shift)
+                recv_k = jax.lax.ppermute(blk, axis, perm)
+                recv_v = jax.lax.ppermute(pv, axis, perm)
+                recv_len = jax.lax.ppermute(lens[row][None], axis, perm)[0]
+            runs_k.append(recv_k)
+            runs_v.append(recv_v)
+            rlens.append(recv_len)
+        reps_k.append(jnp.concatenate(runs_k))
+        reps_v.append(jnp.concatenate(runs_v, axis=0))
+        rep_lens.append(jnp.stack(rlens).astype(jnp.int32))
+    return (
+        merged_k, out_v, out_count, overflow,
+        jnp.stack(reps_k), jnp.stack(reps_v), jnp.stack(rep_lens),
+    )
+
+
+def _parity_ring_exchange_kv_shard(
+    keys, payload, count, splitters, *, num_workers, caps, axis, redundancy,
+    merge_kernel="auto", kernel="lax",
+):
+    """Parity-coded kv exchange phase: `_parity_ring_exchange_shard`'s
+    retained-out-plane + GF(256) parity treatment applied to BOTH planes.
+    Payload rows beyond a bucket's valid length are masked to zero before
+    the fold (unlike keys there is no sentinel, and the parity fold must
+    see deterministic bytes), so the key and payload parity planes stay
+    independently solvable.
+
+    Returns ``(merged_k, out_v, out_count, overflow, sent_k, sent_v,
+    sent_lens, parity_k, parity_v)`` — the kv twin of the keys-parity
+    return: ``sent_v`` is ``(sum(caps), *trailing)``, ``parity_v``
+    ``(npar, max(caps) * row_bytes)`` uint8 received rows.
+    """
+    p = num_workers
+    npar = parity_slots(redundancy)
+    merged_k, out_v, out_count, overflow = _ring_exchange_kv_shard(
+        keys, payload, count, splitters, num_workers=p, caps=caps,
+        axis=axis, merge_kernel=merge_kernel, kernel=kernel,
+    )
+    c = count[0]
+    me = jax.lax.axis_index(axis)
+    starts, lens = _bucket_bounds(keys, c, splitters)
+    cap_max = int(max(caps))
+    sent = sentinel_for(keys.dtype)
+    sent_k, sent_v, sent_lens = [], [], []
+    krows, vrows = [], []
+    for k in range(p):
+        row = (me + jnp.int32(k)) % p
+        blk, idx, pos = _bucket_gather(keys, starts, lens, row, caps[k])
+        mask = (pos < lens[row]).reshape((caps[k],) + (1,) * (payload.ndim - 1))
+        pv = jnp.where(mask, payload[idx], 0)
+        sent_k.append(blk)
+        sent_v.append(pv)
+        sent_lens.append(lens[row])
+        krows.append(_byte_plane(_pad_run(blk, cap_max, sent)))
+        if pv.shape[0] == cap_max:
+            full_v = pv
+        else:
+            full_v = jnp.concatenate(
+                [pv, jnp.zeros((cap_max - pv.shape[0],) + pv.shape[1:],
+                               pv.dtype)],
+                axis=0,
+            )
+        vrows.append(_byte_plane(full_v))
+    recv_k, recv_v = [], []
+    for j, slot in enumerate(_parity_fold(krows, npar)):
+        recv_k.append(jax.lax.ppermute(slot, axis, _ring_perm(p, j + 1)))
+    for j, slot in enumerate(_parity_fold(vrows, npar)):
+        recv_v.append(jax.lax.ppermute(slot, axis, _ring_perm(p, j + 1)))
+    return (
+        merged_k, out_v, out_count, overflow,
+        jnp.concatenate(sent_k),
+        jnp.concatenate(sent_v, axis=0),
+        jnp.stack(sent_lens).astype(jnp.int32),
+        jnp.stack(recv_k),
+        jnp.stack(recv_v),
+    )
 
 
 # -- hierarchical (two-level) schedule: shard program -----------------------

@@ -27,6 +27,8 @@ the splitter-quality feedback loop SURVEY.md §7 calls out for Zipf inputs.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -424,6 +426,23 @@ class SampleSort:
         #: `WorkerFailure` from it aborts the exchange exactly as a device
         #: death surfaced by XLA would.
         self.fault_hook = None
+        #: Optional callable () -> int | None naming the CURRENT measured
+        #: straggler's mesh position (the health plane's rolling verdict,
+        #: or a drill's injected pick).  On a coded dispatch the named
+        #: device's range is raced: the owner fetch vs a replica/parity
+        #: reconstruction, first finisher wins the exactly-once claim
+        #: (`coded_straggler_serve`).  No failure is involved.
+        self.straggler_fn = None
+        #: Optional callable (position) -> seconds of extra latency the
+        #: owner-fetch leg of the straggler race sleeps first — the
+        #: simulation stand-in for a slow device's D2H (the injector's
+        #: `FaultInjector.delay_for` hangs here).
+        self.fetch_delay_fn = None
+        #: Owner-fetch threads that LOST their race and were left to
+        #: finish in the background (the real system discards a late
+        #: straggler response; joining would forfeit the latency win).
+        #: `join_stragglers` drains them before a journal is read.
+        self._straggler_threads: list = []
 
     def _resolve_exchange(self, exchange: str | None) -> str:
         from dsort_tpu.parallel.exchange import resolve_exchange
@@ -436,6 +455,20 @@ class SampleSort:
         return resolve_redundancy(
             redundancy, self.job.redundancy, self.num_workers
         )
+
+    def _resolve_redundancy_mode(self, mode: str | None) -> str:
+        from dsort_tpu.parallel.exchange import resolve_redundancy_mode
+
+        return resolve_redundancy_mode(
+            mode, getattr(self.job, "redundancy_mode", "replicate")
+        )
+
+    def join_stragglers(self) -> None:
+        """Drain owner-fetch threads that lost a straggler race — call
+        before reading the journal (their late ``coded_owner_fetch``
+        lands when the fetch completes, as on real hardware)."""
+        while self._straggler_threads:
+            self._straggler_threads.pop().join()
 
     @functools.lru_cache(maxsize=32)
     def _build(
@@ -610,20 +643,34 @@ class SampleSort:
         )
 
     @functools.lru_cache(maxsize=32)
-    def _build_coded(self, n_local: int, caps: tuple, redundancy: int):
-        """Coded ring exchange (`exchange._coded_ring_exchange_shard`): the
-        measured-caps ring schedule PLUS the replica plane — every bucket
-        additionally ships to its destination's ``redundancy-1`` ring
-        successors, so a lost device's range survives as sorted replica
-        slots on its successors (`parallel.coded`).  Same plan, same caps
-        ladder as `_build_ring`; only built for ``redundancy > 1``.  No
-        donation yet: the coded plane is exercised on the cpu mesh today
-        (XLA CPU ignores donation) — revisit the sorted-keys alias with
-        the ICI port."""
-        from dsort_tpu.parallel.exchange import _coded_ring_exchange_shard
-
-        fn = functools.partial(
+    def _build_coded(
+        self,
+        n_local: int,
+        caps: tuple,
+        redundancy: int,
+        mode: str = "replicate",
+        kv_trailing: tuple | None = None,
+    ):
+        """Coded ring exchange: the measured-caps ring schedule PLUS a
+        redundancy plane — replica slots (every bucket additionally ships
+        to its destination's ``redundancy-1`` ring successors) or parity
+        slots (each device retains its own out-buckets zero-wire and ships
+        only XOR / GF(256) RAID-6 parity of them to its successors), so a
+        lost device's range survives reconstructible off-device
+        (`parallel.coded`).  Same plan, same caps ladder as `_build_ring`;
+        only built for ``redundancy > 1``.  ``kv_trailing`` selects the
+        payload-carrying twins — kv jobs get the same coverage, not a
+        silent uncoded downgrade.  No donation yet: the coded plane is
+        exercised on the cpu mesh today (XLA CPU ignores donation) —
+        revisit the sorted-keys alias with the ICI port."""
+        from dsort_tpu.parallel.exchange import (
+            _coded_ring_exchange_kv_shard,
             _coded_ring_exchange_shard,
+            _parity_ring_exchange_kv_shard,
+            _parity_ring_exchange_shard,
+        )
+
+        kwargs = dict(
             num_workers=self.num_workers,
             caps=caps,
             axis=self.axis,
@@ -631,16 +678,35 @@ class SampleSort:
             merge_kernel=self.job.merge_kernel,
             kernel=self.job.local_kernel,
         )
+        parity = mode == "parity"
+        if kv_trailing is None:
+            shard_fn = (
+                _parity_ring_exchange_shard if parity
+                else _coded_ring_exchange_shard
+            )
+            in_specs = (P(self.axis), P(self.axis), P())
+            n_out = 6 if parity else 5
+        else:
+            shard_fn = (
+                _parity_ring_exchange_kv_shard if parity
+                else _coded_ring_exchange_kv_shard
+            )
+            in_specs = (P(self.axis), P(self.axis), P(self.axis), P())
+            n_out = 9 if parity else 7
+        fn = functools.partial(shard_fn, **kwargs)
+        tag = ("spmd_parity" if parity else "spmd_coded") + (
+            "" if kv_trailing is None else "_kv"
+        )
         return instrument_jit(
             jax.jit(
                 shard_map(
                     fn, mesh=self.mesh,
-                    in_specs=(P(self.axis), P(self.axis), P()),
-                    out_specs=(P(self.axis),) * 5, check_vma=False,
+                    in_specs=in_specs,
+                    out_specs=(P(self.axis),) * n_out, check_vma=False,
                 ),
             ),
             key_fn=lambda *a: (
-                "spmd_coded", self.num_workers, n_local, caps, redundancy,
+                tag, self.num_workers, n_local, caps, redundancy,
                 str(a[0].dtype), self.job.local_kernel,
             ),
         )
@@ -778,7 +844,8 @@ class SampleSort:
 
     def _dispatch_keys_ring(
         self, data: np.ndarray, timer, metrics: Metrics, fused: bool = False,
-        redundancy: int = 1,
+        redundancy: int = 1, mode: str = "replicate",
+        allow_straggler: bool = True,
     ):
         """Ring counterpart of `_dispatch_keys`: plan, size, exchange.
 
@@ -790,12 +857,21 @@ class SampleSort:
         that sized its buffers — an invariant violation, raised loudly.
 
         ``redundancy > 1`` runs the CODED schedule (`_build_coded`): the
-        same plan and caps, plus the replica plane.  The fault hook then
-        fires AFTER the exchange dispatch — replica placement completes
+        same plan and caps, plus the redundancy plane — replica slots
+        (``mode='replicate'``) or XOR/GF(256) parity slots
+        (``mode='parity'``, ~1/P the wire premium).  The fault hook then
+        fires AFTER the exchange dispatch — plane placement completes
         with the exchange (see `parallel.coded`'s simulation note), so a
         loss tripping there leaves the survivors holding everything a
         local reconstruction needs; the raised `WorkerFailure` carries the
         `CodedExchangeState` snapshot for the caller's recovery path.
+
+        When the health plane names a live-but-slow device
+        (`straggler_fn`), the coded plane doubles as a LATENCY shield: the
+        straggler's range is raced — owner fetch vs off-device
+        reconstruction — and whichever leg finishes first serves it
+        (`_serve_straggler_ring`); the dispatch then returns host ranges
+        instead of the sharded device array.
         """
         from dsort_tpu.parallel.exchange import (
             check_ring_overflow,
@@ -825,7 +901,7 @@ class SampleSort:
         if coded:
             note_coded_plan(
                 metrics, caps, hist_h, n_local, p, data.dtype.itemsize,
-                self.job.capacity_factor, redundancy,
+                self.job.capacity_factor, redundancy, mode=mode,
             )
         else:
             note = note_fused_plan if fused else note_ring_plan
@@ -837,10 +913,9 @@ class SampleSort:
             self.fault_hook()
         with timer.phase("spmd_sort"):
             if coded:
-                codedfn = self._build_coded(n_local, caps, redundancy)
-                merged, out_counts, overflow, reps, rep_lens = codedfn(
-                    xs_sorted, cj, splitters
-                )
+                codedfn = self._build_coded(n_local, caps, redundancy, mode)
+                outs = codedfn(xs_sorted, cj, splitters)
+                merged, out_counts, overflow = outs[:3]
             elif fused:
                 fusedfn = self._build_fused(n_local, caps)
                 merged, out_counts, overflow = fusedfn(
@@ -853,14 +928,24 @@ class SampleSort:
             try:
                 self.fault_hook()
             except WorkerFailure as e:
-                # The loss surfaced with the replica plane already placed:
-                # snapshot what the survivors hold so the caller's recovery
-                # is a local merge, not a re-run (parallel.coded).
+                # The loss surfaced with the redundancy plane already
+                # placed: snapshot what the survivors hold so the caller's
+                # recovery is a local merge/solve, not a re-run
+                # (parallel.coded).
                 e.coded_state = self._snapshot_coded(
-                    merged, out_counts, overflow, reps, rep_lens, caps,
-                    redundancy, len(data),
+                    caps, redundancy, len(data), mode, outs
                 )
                 raise
+        if coded and allow_straggler and self.straggler_fn is not None:
+            s = self.straggler_fn()
+            if s is not None and 0 <= int(s) < p:
+                with timer.phase("spmd_sort"):
+                    served = self._serve_straggler_ring(
+                        int(s), outs, caps, redundancy, len(data), mode,
+                        metrics,
+                    )
+                LEDGER.drain_to(metrics)
+                return served
         with timer.phase("spmd_sort"):
             # One fetch = completion barrier + the invariant scalar (same
             # doctrine as the all_to_all path).
@@ -870,22 +955,115 @@ class SampleSort:
         return merged, out_counts, c
 
     def _snapshot_coded(
-        self, merged, out_counts, overflow, reps, rep_lens, caps: tuple,
-        redundancy: int, n: int,
+        self, caps: tuple, redundancy: int, n: int, mode: str, outs,
+        kv: bool = False,
     ):
         """Host snapshot of one coded exchange (`parallel.coded`'s shared
-        fetch: survivors' trimmed ranges + the replica plane, overflow
-        invariant checked first)."""
-        from dsort_tpu.parallel.coded import snapshot_state
+        fetch: survivors' trimmed ranges + the redundancy plane, overflow
+        invariant checked first).  ``outs`` is the coded shard program's
+        full output tuple — its arity selects the matching snapshot
+        (keys/kv x replicate/parity)."""
+        from dsort_tpu.parallel import coded
 
-        return snapshot_state(
-            self.num_workers, redundancy, caps, n,
-            merged, out_counts, overflow, reps, rep_lens,
+        snap = (
+            (coded.snapshot_parity_kv_state if mode == "parity"
+             else coded.snapshot_kv_state)
+            if kv else
+            (coded.snapshot_parity_state if mode == "parity"
+             else coded.snapshot_state)
         )
+        return snap(self.num_workers, redundancy, caps, n, *outs)
+
+    def _serve_straggler_ring(
+        self, s: int, outs, caps: tuple, redundancy: int, n: int,
+        mode: str, metrics: Metrics,
+    ):
+        """Serve the measured straggler's range from whichever source
+        finishes first — owner fetch vs coded reconstruction.
+
+        The health plane named mesh position ``s`` live-but-slow; no
+        failure exists, so no recovery runs.  Two legs race under one
+        `parallel.coded.StragglerClaim` (exactly-once):
+
+        - OWNER: a background thread fetches range ``s`` from its owner,
+          after the injected/measured extra latency (`fetch_delay_fn`) —
+          the simulation stand-in for a slow device's D2H.  It always
+          journals ``coded_owner_fetch`` (won or lost) when the fetch
+          completes, which may be AFTER the sort returned
+          (`join_stragglers` drains it).
+        - HOLDER: runs inline — every OTHER range comes off the coded
+          snapshot anyway, so the wait is shared — and reconstructs
+          range ``s`` from the replica/parity plane exactly as if ``s``
+          were unavailable.
+
+        The winner's copy serves; only a HOLDER win journals the typed
+        ``coded_straggler_serve`` (the contract grammar pins at most one
+        per (job, range) scope).  Both copies are bit-identical — this
+        trades redundant work for tail latency, never correctness.
+        Returns ``(ranges, None, c)`` with host ranges, the list-input
+        form `_assemble_ranges` accepts.
+        """
+        from dsort_tpu.parallel.coded import CodedBudgetExceeded, StragglerClaim
+
+        claim = StragglerClaim()
+        owner_box = {}
+
+        def owner_leg():
+            t0 = time.perf_counter()
+            delay = (
+                self.fetch_delay_fn(s)
+                if self.fetch_delay_fn is not None else None
+            )
+            if delay:
+                time.sleep(float(delay))
+            row = np.asarray(jax.device_get(outs[0])).reshape(
+                self.num_workers, -1
+            )[s]
+            won = claim.claim("owner")
+            if won:
+                owner_box["row"] = row
+            metrics.event(
+                "coded_owner_fetch", range=int(s), won=bool(won),
+                wall_s=round(time.perf_counter() - t0, 6),
+            )
+
+        t = threading.Thread(target=owner_leg, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+        state = self._snapshot_coded(caps, redundancy, n, mode, outs)
+        try:
+            ranges, info = state.reconstruct([s])
+        except CodedBudgetExceeded:
+            # The plane cannot cover s off-device (e.g. degenerate tiny
+            # mesh) — wait for the owner; its fetch is authoritative.
+            t.join()
+            ranges = list(state.ranges)
+            ranges[s] = owner_box["row"][: len(state.ranges[s])]
+            c = np.array([len(r) for r in ranges], np.int64)
+            return ranges, None, c
+        if claim.claim("holder"):
+            metrics.bump("coded_straggler_serves")
+            metrics.event(
+                "coded_straggler_serve", range=int(s), mode=mode,
+                holders=info.get("holders", {}).get(s),
+                recovered_keys=int(len(ranges[s])),
+                wall_s=round(time.perf_counter() - t0, 6),
+            )
+            # The owner's late response is discarded on arrival, as on
+            # real hardware; the thread drains via join_stragglers.
+            self._straggler_threads.append(t)
+        else:
+            # Owner won the claim — its fetch already completed; serve its
+            # copy (bit-identical to the reconstruction by construction).
+            t.join()
+            ranges[s] = owner_box["row"][: len(ranges[s])]
+        c = np.array([len(r) for r in ranges], np.int64)
+        return ranges, None, c
 
     def _dispatch_kv_ring(
         self, xs, vs, cj, n_local: int, trailing: tuple, slot_bytes: int,
-        timer, metrics: Metrics, fused: bool = False,
+        timer, metrics: Metrics, fused: bool = False, redundancy: int = 1,
+        mode: str = "replicate", n: int = 0,
     ):
         """kv ring dispatch: plan (kv local sort + histogram), size, exchange.
 
@@ -895,30 +1073,51 @@ class SampleSort:
         count ONCE per step on both the lax and the fused schedule (on the
         fused path they also move exactly once: the kernel places them by
         the merged tags itself, no post-exchange gather).
+
+        ``redundancy > 1`` runs the coded kv schedule: payload rows get
+        the SAME replica/parity coverage as their keys (no silent uncoded
+        downgrade — ARCHITECTURE §18); the fault hook fires after
+        the exchange with the kv snapshot attached to the raised
+        `WorkerFailure`, exactly as on the keys path.
         """
         from dsort_tpu.parallel.exchange import (
             check_ring_overflow,
+            note_coded_plan,
             note_fused_plan,
             note_ring_plan,
             ring_caps,
         )
+        from dsort_tpu.scheduler.fault import WorkerFailure
 
         p = self.num_workers
+        coded = redundancy > 1
         planfn = self._build_plan(n_local, kv_trailing=trailing)
         with timer.phase("spmd_sort"):
             ks, vsort, splitters, hist = planfn(xs, vs, cj)
             hist_h = jax.device_get(hist)
         LEDGER.drain_to(metrics)
         caps = ring_caps(hist_h, n_local, p)
-        note = note_fused_plan if fused else note_ring_plan
-        note(
-            metrics, caps, hist_h, n_local, p, slot_bytes,
-            self.job.capacity_factor,
-        )
-        if self.fault_hook is not None:
+        if coded:
+            note_coded_plan(
+                metrics, caps, hist_h, n_local, p, slot_bytes,
+                self.job.capacity_factor, redundancy, mode=mode,
+            )
+        else:
+            note = note_fused_plan if fused else note_ring_plan
+            note(
+                metrics, caps, hist_h, n_local, p, slot_bytes,
+                self.job.capacity_factor,
+            )
+        if not coded and self.fault_hook is not None:
             self.fault_hook()
         with timer.phase("spmd_sort"):
-            if fused:
+            if coded:
+                codedfn = self._build_coded(
+                    n_local, caps, redundancy, mode, trailing
+                )
+                outs = codedfn(ks, vsort, cj, splitters)
+                out_k, out_v, out_counts, overflow = outs[:4]
+            elif fused:
                 fusedfn = self._build_fused(n_local, caps, kv_trailing=trailing)
                 out_k, out_v, out_counts, overflow = fusedfn(
                     ks, vsort, cj, splitters, hist
@@ -928,6 +1127,15 @@ class SampleSort:
                 out_k, out_v, out_counts, overflow = ringfn(
                     ks, vsort, cj, splitters
                 )
+        if coded and self.fault_hook is not None:
+            try:
+                self.fault_hook()
+            except WorkerFailure as e:
+                e.coded_state = self._snapshot_coded(
+                    caps, redundancy, n, mode, outs, kv=True
+                )
+                raise
+        with timer.phase("spmd_sort"):
             c, ov = jax.device_get((out_counts, overflow))
         LEDGER.drain_to(metrics)
         check_ring_overflow(ov)
@@ -940,6 +1148,7 @@ class SampleSort:
         keep_on_device: bool = False,
         exchange: str | None = None,
         redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ) -> np.ndarray:
         """Sort a host array; returns the globally sorted host array.
 
@@ -973,12 +1182,13 @@ class SampleSort:
                     "misread); use sort() for floats"
                 )
             return self._sort_device_impl(
-                data, metrics, exchange=exchange, redundancy=redundancy
+                data, metrics, exchange=exchange, redundancy=redundancy,
+                redundancy_mode=redundancy_mode,
             )
         if is_float_key_dtype(data.dtype):
             return sort_float_keys_via_uint(
                 self.sort, data, metrics, exchange=exchange,
-                redundancy=redundancy,
+                redundancy=redundancy, redundancy_mode=redundancy_mode,
             )
         if len(data) == 0:
             return np.asarray(data).copy()
@@ -986,13 +1196,15 @@ class SampleSort:
         # in global order, so the buffer IS the sorted array — no
         # np.concatenate re-copy (VERDICT r4 next #1).
         buf, _ = self._sort_ranges_impl(
-            data, metrics, exchange=exchange, redundancy=redundancy
+            data, metrics, exchange=exchange, redundancy=redundancy,
+            redundancy_mode=redundancy_mode,
         )
         return buf
 
     def sort_ranges(
         self, data: np.ndarray, metrics: Metrics | None = None,
         exchange: str | None = None, redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ) -> list[np.ndarray]:
         """Like `sort`, but returns the per-device key ranges separately.
 
@@ -1004,12 +1216,14 @@ class SampleSort:
         uints *before* any checkpointed phase).
         """
         return self._sort_ranges_impl(
-            data, metrics, exchange=exchange, redundancy=redundancy
+            data, metrics, exchange=exchange, redundancy=redundancy,
+            redundancy_mode=redundancy_mode,
         )[1]
 
     def _sort_ranges_impl(
         self, data: np.ndarray, metrics: Metrics | None = None,
         exchange: str | None = None, redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ) -> tuple[np.ndarray, list[np.ndarray]]:
         """Shared core: returns ``(sorted buffer, per-device range views)``.
 
@@ -1038,7 +1252,8 @@ class SampleSort:
         metrics = metrics if metrics is not None else Metrics()
         timer = PhaseTimer(metrics)
         merged, _, c = self._dispatch_keys(
-            data, timer, metrics, exchange, redundancy
+            data, timer, metrics, exchange, redundancy,
+            redundancy_mode=redundancy_mode,
         )
         with timer.phase("assemble"):
             return self._assemble_ranges(merged, c, len(data), self.num_workers)
@@ -1046,6 +1261,7 @@ class SampleSort:
     def _dispatch_keys(
         self, data: np.ndarray, timer, metrics: Metrics,
         exchange: str | None = None, redundancy: int | None = None,
+        redundancy_mode: str | None = None, allow_straggler: bool = True,
     ):
         """Upload + run the SPMD program with measured-capacity retries.
 
@@ -1069,6 +1285,7 @@ class SampleSort:
         journaled.
         """
         red = self._resolve_redundancy(redundancy)
+        mode = self._resolve_redundancy_mode(redundancy_mode)
         if getattr(self.job, "autotune", False):
             from dsort_tpu.obs.plan import planned_exchange
             from dsort_tpu.parallel.exchange import resolve_hier_hosts
@@ -1116,7 +1333,8 @@ class SampleSort:
             exch = "ring"
         if exch in ("ring", "fused"):
             return self._dispatch_keys_ring(
-                data, timer, metrics, fused=exch == "fused", redundancy=red
+                data, timer, metrics, fused=exch == "fused", redundancy=red,
+                mode=mode, allow_straggler=allow_straggler,
             )
         p = self.num_workers
         shard_spec = NamedSharding(self.mesh, P(self.axis))
@@ -1164,6 +1382,7 @@ class SampleSort:
     def _sort_device_impl(
         self, data: np.ndarray, metrics: Metrics | None,
         exchange: str | None = None, redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ):
         """`keep_on_device` core: dispatch, then hand out the sharded result.
 
@@ -1185,8 +1404,12 @@ class SampleSort:
                 n=0, metrics=metrics,
             )
         else:
+            # Straggler serving returns host ranges — incompatible with a
+            # device-resident result, so the race is disabled here; the
+            # coded fault plane itself still applies.
             merged, out_counts, c = self._dispatch_keys(
-                data, timer, metrics, exchange, redundancy
+                data, timer, metrics, exchange, redundancy,
+                redundancy_mode=redundancy_mode, allow_straggler=False,
             )
             handle = DeviceSortResult(
                 merged,
@@ -1206,9 +1429,18 @@ class SampleSort:
     def _assemble_ranges(
         self, merged, c, n: int, p: int
     ) -> tuple[np.ndarray, list[np.ndarray]]:
-        """Land per-device ranges into one output buffer, fetches overlapped."""
-        out = np.empty(n, dtype=merged.dtype)
-        row = _shard_rows(merged, p)
+        """Land per-device ranges into one output buffer, fetches overlapped.
+
+        ``merged`` is either the sharded device array or — after a
+        straggler serve — an already-host list of trimmed per-device
+        ranges; both land through the same copy loop.
+        """
+        if isinstance(merged, list):
+            out = np.empty(n, dtype=merged[0].dtype if merged else np.int32)
+            row = lambda i: merged[i]  # noqa: E731 — mirrors _shard_rows
+        else:
+            out = np.empty(n, dtype=merged.dtype)
+            row = _shard_rows(merged, p)
         ranges, off = [], 0
         for i in range(p):
             ci = int(c[i])
@@ -1228,6 +1460,8 @@ class SampleSort:
         metrics: Metrics | None = None,
         secondary: np.ndarray | None = None,
         exchange: str | None = None,
+        redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """TeraSort-style key+payload sort; payloads follow their keys.
 
@@ -1237,22 +1471,37 @@ class SampleSort:
         of relying on prefix uniqueness.  With a secondary the combine always
         uses the ``lax.sort`` merge; every other ``JobConfig.merge_kernel``
         ('bitonic', 'block_merge') is ignored on this path (warned below).
+
+        ``redundancy > 1`` runs the coded ring schedule with FULL payload
+        coverage: record replicas or parity rows ride the plane next to
+        their keys, so a kv job's mid-shuffle loss recovers by local merge
+        exactly like a keys job's (v1 silently downgraded kv to uncoded).
+        A ``secondary`` key still forces the all_to_all combine, which has
+        no coded plane — that one remaining downgrade is warned.
         """
         keys = np.asarray(keys)
         if is_float_key_dtype(keys.dtype):
             return sort_float_keys_via_uint(
                 self.sort_kv, keys, payload, metrics, secondary,
-                exchange=exchange,
+                exchange=exchange, redundancy=redundancy,
+                redundancy_mode=redundancy_mode,
             )
         exch = self._resolve_exchange(exchange)
-        if self._resolve_redundancy(None) > 1:
-            # The replica plane is keys-only today: payload replicas would
-            # r-x the exchange's payload traffic for a recovery the k-way
-            # record merge paths don't consume yet (ARCHITECTURE §14 scope).
+        red = self._resolve_redundancy(redundancy)
+        mode = self._resolve_redundancy_mode(redundancy_mode)
+        if red > 1 and secondary is not None:
             log.warning(
-                "redundancy=%d applies to keys-only jobs; this kv sort "
-                "runs uncoded (re-run recovery)", self.job.redundancy,
+                "redundancy=%d needs the ring schedule, which has no "
+                "secondary-key channel; this two-level-key sort runs "
+                "uncoded (re-run recovery)", red,
             )
+            red = 1
+        if red > 1 and exch not in ("ring",):
+            log.warning(
+                "redundancy=%d needs the lax ring schedule; overriding "
+                "exchange=%r to 'ring' for this kv dispatch", red, exch,
+            )
+            exch = "ring"
         if exch == "hier":
             # The two-level schedule is keys-only today: the payload plane
             # would need tag channels through both the aggregation merge and
@@ -1306,7 +1555,8 @@ class SampleSort:
         if exch in ("ring", "fused"):
             out_k, out_v, c = self._dispatch_kv_ring(
                 xs, vs, cj, n_local, tuple(sv.shape[2:]), slot_bytes,
-                timer, metrics, fused=exch == "fused",
+                timer, metrics, fused=exch == "fused", redundancy=red,
+                mode=mode, n=len(keys),
             )
         else:
             cap_pair = self._cap_pair(n_local, self.job.capacity_factor)

@@ -144,6 +144,7 @@ class FaultInjector:
         self._killed: set[int] = set()
         self._one_shots: dict[tuple[int, str], int] = {}
         self._hangs: dict[tuple[int, str], float] = {}
+        self._slow: dict[int, float] = {}
         self._sequence: list[tuple[int, str]] = []
         self.trips = 0
 
@@ -181,6 +182,33 @@ class FaultInjector:
         worker the reference can never detect (SURVEY.md §5.3)."""
         with self._lock:
             self._hangs[(worker, stage)] = seconds
+
+    def slow(self, worker: int, seconds: float) -> None:
+        """Mark ``worker`` live-but-slow: its owner-side fetches take
+        ``seconds`` of extra latency (the straggler drill — no failure is
+        injected; the coded plane's straggler-first serving races the
+        delayed fetch against an off-device reconstruction).  Clear with
+        ``slow(worker, 0)``."""
+        with self._lock:
+            if seconds > 0:
+                self._slow[int(worker)] = float(seconds)
+            else:
+                self._slow.pop(int(worker), None)
+
+    def delay_for(self, worker: int) -> float:
+        """Extra fetch latency `slow` assigned to ``worker`` (0.0 when
+        healthy) — `SampleSort.fetch_delay_fn`'s injector binding."""
+        with self._lock:
+            return self._slow.get(int(worker), 0.0)
+
+    def straggler(self) -> int | None:
+        """The slowest currently-marked worker, or None — the injector's
+        `SampleSort.straggler_fn` binding (a real deployment binds the
+        health plane's measured verdict instead, `obs.health`)."""
+        with self._lock:
+            if not self._slow:
+                return None
+            return max(self._slow, key=self._slow.get)
 
     def check(self, worker: int, stage: str) -> None:
         """Raise WorkerFailure (or stall) if an injected fault applies here."""

@@ -189,7 +189,7 @@ def _size_bucket(n: int) -> int:
     return 1 << max(int(n - 1).bit_length(), 0) if n > 1 else 1
 
 
-def _sort_kwargs(exchange, redundancy) -> dict:
+def _sort_kwargs(exchange, redundancy, redundancy_mode=None) -> dict:
     """Per-call knob kwargs, omitted when unset: `None` means "JobConfig
     decides" and needs no plumbing — wrappers around SampleSort.sort /
     sort_ranges (fault drills monkeypatch them) keep their original
@@ -198,6 +198,8 @@ def _sort_kwargs(exchange, redundancy) -> dict:
     kw = {} if exchange is None else {"exchange": exchange}
     if redundancy is not None:
         kw["redundancy"] = redundancy
+    if redundancy_mode is not None:
+        kw["redundancy_mode"] = redundancy_mode
     return kw
 
 
@@ -733,6 +735,7 @@ class SpmdScheduler:
         self, work: np.ndarray, ckpt, ss, metrics: Metrics, live: list[int],
         cancelled: threading.Event | None = None,
         exchange: str | None = None, redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ) -> np.ndarray:
         """Phase B with per-range persistence (SURVEY.md §5.4, upgraded).
 
@@ -757,10 +760,11 @@ class SpmdScheduler:
                     [ckpt.load_range(i) for i in sorted(done)]
                 )
             return self._resume_missing_ranges(
-                work, ckpt, ss, done, metrics, cancelled, exchange, redundancy
+                work, ckpt, ss, done, metrics, cancelled, exchange,
+                redundancy, redundancy_mode,
             )
         outs = ss.sort_ranges(
-            work, metrics, **_sort_kwargs(exchange, redundancy)
+            work, metrics, **_sort_kwargs(exchange, redundancy, redundancy_mode)
         )
         self._check_cancelled(cancelled)
         # Fresh sort: the range views share ONE backing buffer already laid
@@ -802,6 +806,7 @@ class SpmdScheduler:
         self, work: np.ndarray, ckpt, ss, done: list[int], metrics: Metrics,
         cancelled: threading.Event | None = None,
         exchange: str | None = None, redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ) -> np.ndarray:
         """Re-sort only the key intervals whose ranges were lost.
 
@@ -842,7 +847,7 @@ class SpmdScheduler:
             len(subset), len(work),
         )
         sorted_subset = ss.sort(
-            subset, metrics, **_sort_kwargs(exchange, redundancy)
+            subset, metrics, **_sort_kwargs(exchange, redundancy, redundancy_mode)
         )
         present_concat = (
             np.concatenate(present) if present else subset[:0]
@@ -994,6 +999,7 @@ class SpmdScheduler:
         keep_on_device: bool = False,
         exchange: str | None = None,
         redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ) -> np.ndarray:
         """Whole-mesh sort; with ``keep_on_device=True`` the result stays
         sharded on the mesh as a `parallel.DeviceSortResult` under the SAME
@@ -1026,7 +1032,7 @@ class SpmdScheduler:
             # checkpointed run of raw floats would already have dropped NaNs.
             return sort_float_keys_via_uint(
                 self.sort, data, metrics, job_id, exchange=exchange,
-                redundancy=redundancy,
+                redundancy=redundancy, redundancy_mode=redundancy_mode,
             )
         metrics = metrics if metrics is not None else Metrics()
         if self.flight is not None:
@@ -1144,13 +1150,32 @@ class SpmdScheduler:
                             raise err
 
                     ss.fault_hook = ring_hook
+                    # Straggler seams (ARCHITECTURE §18): the injector names
+                    # a live-but-slow WORKER; SampleSort thinks in mesh
+                    # POSITIONS, so both bindings translate through the
+                    # attempt's live list.  A real deployment binds the
+                    # health plane's measured verdict here instead
+                    # (`obs.health.straggler_position`).
+
+                    def straggler_pos():
+                        w = self.injector.straggler()
+                        if w is None or w not in current:
+                            return None
+                        return current.index(w)
+
+                    ss.straggler_fn = straggler_pos
+                    ss.fetch_delay_fn = lambda pos: self.injector.delay_for(
+                        current[pos]
+                    ) if 0 <= pos < len(current) else 0.0
                 else:
                     ss.fault_hook = None
+                    ss.straggler_fn = None
+                    ss.fetch_delay_fn = None
                 # Pass the override only when the caller set one: `None`
                 # means "JobConfig.exchange decides" and needs no plumbing —
                 # wrappers around SampleSort.sort (fault drills monkeypatch
                 # it) keep their pre-exchange signature working.
-                kw = _sort_kwargs(exchange, redundancy)
+                kw = _sort_kwargs(exchange, redundancy, redundancy_mode)
                 if keep_on_device:
                     return ss.sort(work, metrics, keep_on_device=True, **kw)
                 if ckpt is None:
@@ -1158,6 +1183,7 @@ class SpmdScheduler:
                 return self._shuffle_with_range_checkpoint(
                     work, ckpt, ss, metrics, live, cancelled,
                     exchange=exchange, redundancy=redundancy,
+                    redundancy_mode=redundancy_mode,
                 )
 
             try:
@@ -1177,6 +1203,7 @@ class SpmdScheduler:
                     out._rerun = lambda: self.sort(
                         data, metrics=metrics, keep_on_device=True,
                         exchange=exchange, redundancy=redundancy,
+                        redundancy_mode=redundancy_mode,
                     )
                     self._register_handle(out)
                 metrics.event(

@@ -76,6 +76,9 @@ class JobTicket:
         # Per-job redundancy override (the fleet planner's r, obs.plan);
         # None = JobConfig.redundancy.
         self.redundancy: int | None = None
+        # The mode axis of the same override (ARCHITECTURE §18):
+        # "replicate" | "parity"; None = JobConfig.redundancy_mode.
+        self.redundancy_mode: str | None = None
         # Coded redundancy (ARCHITECTURE §14): a coded job evicted by a
         # device loss parks its replica snapshot here; the re-dispatch then
         # completes from replica slots instead of re-running the sort.
@@ -193,6 +196,34 @@ class SortService:
         if telemetry is not None:
             telemetry.attach(self._svc_metrics)
         self.planner.attach(self._svc_metrics)
+        # Closed-loop slice width (ARCHITECTURE §15 axis of §18's PR):
+        # with autotune on and SERVE_SLICE_DEVICES genuinely unset, the
+        # slice_devices policy re-sizes the small-job sub-slice from the
+        # journaled admission mix (an empty/fresh journal keeps the
+        # configured width); the decision — or the explicit key's
+        # plan_override — lands in the service journal before any
+        # worker thread starts, so replay sees it ahead of dispatch.
+        if self._sched is not None:
+            from dsort_tpu.obs.plan import planned_slice_devices
+
+            records = []
+            if journal is not None and hasattr(journal, "events"):
+                records = [
+                    {"type": e.type, **e.fields} for e in journal.events()
+                ]
+            cur = max(min(self.serve.slice_devices, len(self._devices)), 1)
+            planned = int(planned_slice_devices(
+                self.job, self.serve, cur, len(self._devices), records,
+                self._svc_metrics,
+            ))
+            s = max(min(planned, len(self._devices)), 1)
+            if s != cur:
+                devs = self._devices
+                groups = [
+                    devs[i: i + s] for i in range(0, len(devs) - s + 1, s)
+                ]
+                self._slices = {i: g for i, g in enumerate(groups or [devs])}
+                self._free = set(self._slices)
         self.flight = None
         if self.job.flight_recorder_dir:
             from dsort_tpu.obs.flight import FlightRecorder
@@ -251,6 +282,7 @@ class SortService:
         job_id: str | None = None,
         ckpt_job_id: str | None = None,
         redundancy: int | None = None,
+        redundancy_mode: str | None = None,
     ) -> tuple[Admission, JobTicket | None]:
         """Admit one keys-only sort job; returns ``(verdict, ticket)``.
 
@@ -260,7 +292,8 @@ class SortService:
         path when ``JobConfig.checkpoint_dir`` is set.  ``redundancy``
         is a per-job override of ``JobConfig.redundancy`` — the fleet
         controller's planned ``r`` (obs.plan's redundancy policy) arrives
-        here via the dispatch header.
+        here via the dispatch header; ``redundancy_mode``
+        ("replicate" | "parity") is the same override's mode axis.
         """
         data = np.asarray(data)
         tenant = tenant or self.job.tenant
@@ -288,6 +321,7 @@ class SortService:
             tap.attach(metrics)
         ticket = JobTicket(data, tenant, job_id, ckpt_job_id, metrics)
         ticket.redundancy = redundancy
+        ticket.redundancy_mode = redundancy_mode
         metrics.bump("jobs_admitted")
         metrics.event(
             "job_admitted", tenant=tenant, queue_depth=verdict.queue_depth,
@@ -423,6 +457,7 @@ class SortService:
         return self._sched.sort(
             ticket.data, metrics=m, job_id=ticket.ckpt_job_id,
             redundancy=getattr(ticket, "redundancy", None),
+            redundancy_mode=getattr(ticket, "redundancy_mode", None),
         )
 
     def _sort_small(self, ticket: JobTicket, sid: int) -> np.ndarray:

@@ -142,9 +142,10 @@ EVENT_TYPES: dict[str, str] = {
                       "penalizes it for big jobs (agent, score, "
                       "dominant_phase)",
     # Coded redundancy plane (parallel.coded, ARCHITECTURE §14):
-    "coded_replica_ship": "one coded exchange planned its replica plane — "
-                          "every bucket re-shipped to its destination's "
-                          "r-1 ring successors (redundancy, slots, bytes)",
+    "coded_replica_ship": "one coded exchange planned its redundancy plane "
+                          "— full bucket copies to r-1 ring successors "
+                          "(mode=replicate) or GF(256) parity slots "
+                          "(mode=parity) (redundancy, mode, slots, bytes)",
     "coded_recover": "a dead device's range was reconstructed by a LOCAL "
                      "merge of a survivor's replica slots — zero keys "
                      "re-sorted, zero re-dispatch (dead, holders, "
@@ -153,6 +154,23 @@ EVENT_TYPES: dict[str, str] = {
                              "range's every holder dead too); recovery "
                              "degraded cleanly to the re-run path (dead, "
                              "redundancy)",
+    # Coded exchange v2 (parity + straggler serving, ARCHITECTURE §18):
+    "parity_recover": "a dead device's range was reconstructed through the "
+                      "GF(256) parity plane — survivors' retained out-"
+                      "buckets plus XOR/RAID-6 parity slots solved the "
+                      "missing buckets (dead, holders, recovered_keys, "
+                      "replica_bytes, redundancy, mode, wall_s)",
+    "coded_straggler_serve": "a range owned by the measured straggler was "
+                             "served from the replica/parity plane because "
+                             "the reconstruction finished before the "
+                             "owner's fetch — the exactly-once claim of "
+                             "the straggler-first protocol (range, mode, "
+                             "holders, recovered_keys, wall_s)",
+    "coded_owner_fetch": "the straggler-first race's owner leg completed — "
+                         "``won`` says whether the owner's own fetch beat "
+                         "the reconstruction (the serve event is then "
+                         "absent) or arrived late and was discarded "
+                         "(range, won, wall_s)",
     # Planner plane (obs.plan, ARCHITECTURE §15):
     "plan_decision": "the closed-loop planner chose a knob value from "
                      "measured inputs, journaled BEFORE dispatch (policy — "
@@ -262,6 +280,9 @@ COUNTERS: dict[str, str] = {
                            "(also charged to exchange_bytes_on_wire)",
     "coded_recovered_keys": "keys reconstructed from replica slots by "
                             "coded recoveries (merged, never re-sorted)",
+    "coded_straggler_serves": "ranges served from the replica/parity plane "
+                              "ahead of their measured-straggler owner "
+                              "(no failure involved; parallel.coded)",
     "plan_decisions": "knob values the closed-loop planner chose from "
                       "measured inputs (obs.plan; each journals a "
                       "plan_decision)",

@@ -1,0 +1,46 @@
+"""Fixture: coded-v2 straggler/parity discipline violations (DS201/DS202 + DS301).
+
+Models the v2 plane's two riskiest shapes: the exactly-once straggler
+claim whose winner slot must stay lock-guarded with no blocking work
+under the lock (joining the racing owner thread — or sleeping out its
+injected delay — while holding the claim lock would serialize every
+range's serve behind one slow fetch), and a parity exchange shard whose
+recovery journaling must never run inside the traced program (the solve
+wall time would become a trace-time constant and the serve event would
+fire once per compile, not per race).
+"""
+
+import threading
+import time
+
+import jax
+
+
+class StragglerClaim:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._winner = None
+        self._served = []
+
+    def claim(self, leg):
+        with self._lock:
+            if self._winner is None:
+                self._winner = leg
+                return True
+            return False
+
+    def claim_racy(self, leg):
+        self._winner = leg  # DS201: guarded attribute, no lock held
+
+    def serve_under_lock(self, owner_thread, delay):
+        with self._lock:
+            time.sleep(delay)  # DS202: the injected straggler delay, lock held
+            owner_thread.join()  # DS202: blocking owner-leg join under the lock
+
+
+@jax.jit
+def serve_inside_trace(x, metrics):
+    metrics.event("coded_straggler_serve", range=3, mode="parity")  # DS301
+    t0 = time.perf_counter()  # DS301: solve wall clock baked at trace
+    print("served at", t0)  # DS301
+    return x ^ 1

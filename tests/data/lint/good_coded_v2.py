@@ -1,0 +1,47 @@
+"""Fixture: near-miss clean twin of bad_coded_v2 — all discipline kept.
+
+The shapes `parallel.coded`'s v2 plane actually ships: the claim lock
+held only for the compare-and-set, the owner join and the injected delay
+both OUTSIDE it, and the parity solve's wall clock measured AROUND the
+host-side reconstruction, never inside a traced function.
+"""
+
+import threading
+import time
+
+import jax
+
+
+class StragglerClaim:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._winner = None
+        self._served = []
+
+    def claim(self, leg):
+        with self._lock:  # compare-and-set only; nothing blocks in here
+            if self._winner is None:
+                self._winner = leg
+                self._served.append(leg)
+                return True
+            return False
+
+    def serve_outside_lock(self, owner_thread, delay):
+        time.sleep(delay)  # the owner leg sleeps on its own thread's time
+        won = self.claim("owner")  # lock released inside claim
+        if not won:
+            owner_thread.join()  # late-loser drain never holds the lock
+        return won
+
+
+@jax.jit
+def pure_parity_step(x):
+    return x ^ 1
+
+
+def serve_around_trace(x, metrics):
+    t0 = time.perf_counter()  # host-side wall clock AROUND the traced call
+    y = pure_parity_step(x)
+    metrics.event("coded_straggler_serve", range=3, mode="parity",
+                  wall_s=time.perf_counter() - t0)
+    return y

@@ -167,7 +167,9 @@ def test_coded_forces_ring_from_alltoall_and_fused(mesh8, caplog):
         assert m.counters.get("fused_exchange_launches", 0) == 0
 
 
-def test_coded_kv_warns_and_runs_uncoded(mesh8, caplog):
+def test_coded_kv_runs_coded(mesh8):
+    """v2 (§18) retired the kv warn-and-run-uncoded downgrade: payload
+    rows ride the replica plane and the premium is priced."""
     from dsort_tpu.data.ingest import gen_terasort
 
     tk, tv = gen_terasort(4096, seed=3)
@@ -180,8 +182,11 @@ def test_coded_kv_warns_and_runs_uncoded(mesh8, caplog):
     )
     m = _metered()
     out_k, out_v = ss.sort_kv(tk, tv, metrics=m)
-    np.testing.assert_array_equal(out_k, np.sort(tk))
-    assert m.counters.get("coded_replica_bytes", 0) == 0  # uncoded
+    order = np.argsort(tk, kind="stable")
+    np.testing.assert_array_equal(out_k, tk[order])
+    np.testing.assert_array_equal(out_v, tv[order])
+    assert m.counters["coded_replica_bytes"] > 0  # kv premium is priced
+    assert "coded_replica_ship" in m.journal.types()
 
 
 def test_fault_snapshot_reconstructs_every_loss_shape(mesh8):

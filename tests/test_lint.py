@@ -127,6 +127,18 @@ def test_coded_fixture():
     assert run_fixture("good_coded.py") == []
 
 
+def test_coded_v2_fixture():
+    """ISSUE 19: the coded-v2 discipline contract — the exactly-once
+    straggler claim stays lock-guarded with the owner join and injected
+    delay outside the lock, and no serve event or solve clock is emitted
+    from inside a traced function (the race outcome would become a
+    trace-time constant)."""
+    diags = run_fixture("bad_coded_v2.py")
+    counts = {c: codes_of(diags).count(c) for c in set(codes_of(diags))}
+    assert counts == {"DS201": 1, "DS202": 2, "DS301": 3}
+    assert run_fixture("good_coded_v2.py") == []
+
+
 def test_plan_fixture():
     """ISSUE 16: the planner plane's discipline contract — the rolling
     signal state stays lock-guarded with the skew probe outside the lock,

@@ -58,8 +58,8 @@ def test_plan_events_and_counters_registered():
     for counter in ("plan_decisions", "plan_overrides"):
         assert counter in COUNTERS
     assert PLAN_POLICIES == (
-        "exchange", "wave_elems", "redundancy", "prewarm",
-        "dispatch_timeout_s",
+        "exchange", "wave_elems", "redundancy", "redundancy_mode",
+        "prewarm", "dispatch_timeout_s", "slice_devices",
     )
     assert PLAN_DECISION_FIELDS == ("policy", "chosen", "inputs", "rejected")
     assert PLAN_OVERRIDE_FIELDS == ("policy", "explicit", "planned", "inputs")
