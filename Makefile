@@ -5,6 +5,9 @@ NATIVE := dsort_tpu/runtime/native
 lint:  ## project-native static analysis (registry/concurrency/tracing/...)
 	$(PY) -m dsort_tpu.cli lint
 
+lint-sarif:  ## lint as SARIF 2.1.0 (code-scanning upload) -> lint.sarif
+	$(PY) -m dsort_tpu.cli lint --format sarif > lint.sarif
+
 baseline:  ## record current findings as tolerated (ship this file EMPTY)
 	$(PY) -m dsort_tpu.cli lint --write-baseline
 
@@ -98,4 +101,4 @@ ubsan:  ## build + run the native selftest under UBSanitizer
 
 sanitize: tsan asan ubsan  ## all three sanitizer selftest runs
 
-.PHONY: lint baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke spec-smoke profile-smoke external-smoke coded-smoke coded-v2-smoke autotune-smoke hier-smoke bench-compare bench-history native tsan asan ubsan sanitize
+.PHONY: lint lint-sarif baseline test bench-smoke bench-exchange-smoke bench-fused-smoke fused-smoke serve-smoke fleet-smoke spec-smoke profile-smoke external-smoke coded-smoke coded-v2-smoke autotune-smoke hier-smoke bench-compare bench-history native tsan asan ubsan sanitize

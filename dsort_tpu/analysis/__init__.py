@@ -24,7 +24,9 @@ from dsort_tpu.analysis.core import (  # noqa: F401
 )
 from dsort_tpu.analysis.engine import (  # noqa: F401
     Checker,
+    LintStats,
     format_json,
+    format_sarif,
     format_text,
     lint_paths,
 )

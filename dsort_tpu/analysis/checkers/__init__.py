@@ -8,6 +8,7 @@ it up from this one function.
 
 from __future__ import annotations
 
+from dsort_tpu.analysis.checkers.caps import CapsChecker
 from dsort_tpu.analysis.checkers.compat import CompatChecker
 from dsort_tpu.analysis.checkers.concurrency import ConcurrencyChecker
 from dsort_tpu.analysis.checkers.durability import DurabilityChecker
@@ -17,6 +18,7 @@ from dsort_tpu.analysis.checkers.lifecycle import LifecycleChecker
 from dsort_tpu.analysis.checkers.protocol import ProtocolChecker
 from dsort_tpu.analysis.checkers.registry import RegistryChecker
 from dsort_tpu.analysis.checkers.spec import SpecChecker
+from dsort_tpu.analysis.checkers.spmd import SpmdChecker
 from dsort_tpu.analysis.checkers.tracing import TracingChecker
 
 
@@ -32,6 +34,8 @@ def all_checkers():
         ProtocolChecker(),
         LifecycleChecker(),
         SpecChecker(),
+        SpmdChecker(),
+        CapsChecker(),
     ]
 
 

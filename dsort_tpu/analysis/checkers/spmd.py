@@ -1,0 +1,768 @@
+"""DS12xx: the collective-schedule verifier.
+
+Proves, per module that declares an ``SPMD_CONTRACT`` (and REQUIRES the
+declaration from the modules `spmd.registry` lists):
+
+- DS1201 — every ``ppermute`` table is the declared permutation of the
+  mesh axis: each closed-form builder is evaluated over the bounded
+  (P, step) grid and checked for validity (in-range, no duplicate source
+  or destination, full builders cover the axis) AND conformance to the
+  contract's expected destination form — an inverted shift is still a
+  bijection, so validity alone would not catch it.  Every ``ppermute``
+  call site must trace its table to a declared builder.
+- DS1202 — no collective under a trace-divergent branch: a collective
+  inside an ``if`` whose test derives from ``axis_index`` (or a
+  ``lax.cond``/``switch`` on such a predicate whose branch issues one)
+  deadlocks the mesh when devices disagree.  Host-plane modules
+  (``plane: "host"``) must issue no collectives at all.
+- DS1203 — every axis name a collective uses resolves to a constructed
+  mesh axis: either the contract's declared axis parameter (bound by the
+  caller's ``shard_map``) or a string literal in the registry's
+  ``MESH_AXES`` vocabulary, which itself must be defined by the mesh
+  construction sources.
+- DS1204 — every started remote DMA's (slot, step) write region is
+  disjoint from all others in the same kernel: the ``pl.ds(offs[k],
+  caps[k])`` destinations are evaluated from the kernel's own offset
+  arithmetic over sample caps ladders and checked pairwise.
+
+DS1200 is the loud-failure channel: a missing/malformed contract, an
+undeclared required minimum, or a closed form that left the statically
+evaluable subset can never pass vacuously.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dsort_tpu.analysis.astutil import callee_basename
+from dsort_tpu.analysis.core import Diagnostic
+from dsort_tpu.analysis.engine import Checker, FileContext
+from dsort_tpu.analysis.spmd.contract import (
+    ContractError,
+    extract_contract,
+    iter_domain,
+    load_spmd_registry,
+)
+from dsort_tpu.analysis.spmd.symeval import (
+    EvalError,
+    Evaluator,
+    extract_functions,
+)
+
+#: Collective operations the verifier tracks (mesh-blocking: every device
+#: must issue the same sequence).
+COLLECTIVES = {
+    "ppermute",
+    "pshuffle",
+    "all_gather",
+    "all_to_all",
+    "psum",
+    "psum_scatter",
+    "pmax",
+    "pmin",
+    "make_async_remote_copy",
+}
+
+#: Names whose results vary per device under one trace (taint seeds).
+_DEVICE_VARYING = {"axis_index", "program_id"}
+
+#: Remote-DMA regions with no static extent act as whole-buffer writes.
+_WHOLE = (0, 1 << 62)
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _FnScan:
+    """One function's SPMD-relevant surface, def-boundary scoped."""
+
+    def __init__(self, fn: ast.FunctionDef):
+        self.fn = fn
+        self.sites: list[tuple[ast.Call, list[ast.expr]]] = []
+        self.conds: list[tuple[ast.Call, list[ast.expr]]] = []
+        self.assign_calls: dict[str, list[str]] = {}
+        self.local_defs: dict[str, ast.FunctionDef] = {}
+        self._stmts(fn.body, [])
+        self.tainted = self._taint()
+
+    def _stmts(self, stmts, tests: list[ast.expr]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.local_defs[st.name] = st
+                continue
+            if isinstance(st, ast.If):
+                self._exprs(st.test, tests)
+                inner = tests + [st.test]
+                self._stmts(st.body, inner)
+                self._stmts(st.orelse, inner)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._exprs(st.iter, tests)
+                self._stmts(st.body, tests)
+                self._stmts(st.orelse, tests)
+            elif isinstance(st, ast.While):
+                self._exprs(st.test, tests)
+                self._stmts(st.body, tests)
+                self._stmts(st.orelse, tests)
+            elif isinstance(st, ast.Try):
+                self._stmts(st.body, tests)
+                for h in st.handlers:
+                    self._stmts(h.body, tests)
+                self._stmts(st.orelse, tests)
+                self._stmts(st.finalbody, tests)
+            elif isinstance(st, ast.With):
+                for item in st.items:
+                    self._exprs(item.context_expr, tests)
+                self._stmts(st.body, tests)
+            else:
+                self._exprs(st, tests)
+
+    def _exprs(self, node: ast.AST, tests: list[ast.expr]) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                base = callee_basename(n.func)
+                if base in COLLECTIVES:
+                    self.sites.append((n, tests))
+                elif base in ("cond", "switch"):
+                    self.conds.append((n, tests))
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                base = callee_basename(n.value.func)
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        self.assign_calls.setdefault(t.id, []).append(base)
+
+    def _taint(self) -> set[str]:
+        assigns: list[tuple[set[str], ast.expr]] = []
+        for st in ast.walk(self.fn):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if st is not self.fn:
+                    continue
+            targets: list[ast.expr] = []
+            value = None
+            if isinstance(st, ast.Assign):
+                targets, value = st.targets, st.value
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                targets, value = [st.target], st.value
+            elif isinstance(st, ast.AugAssign):
+                targets, value = [st.target], st.value
+            if value is None:
+                continue
+            names = set()
+            for t in targets:
+                names |= _names_in(t)
+            assigns.append((names, value))
+        tainted: set[str] = set()
+        for names, value in assigns:
+            for n in ast.walk(value):
+                if (
+                    isinstance(n, ast.Call)
+                    and callee_basename(n.func) in _DEVICE_VARYING
+                ):
+                    tainted |= names
+        for _ in range(len(assigns) + 1):
+            grew = False
+            for names, value in assigns:
+                if names <= tainted:
+                    continue
+                if _names_in(value) & tainted:
+                    tainted |= names
+                    grew = True
+            if not grew:
+                break
+        return tainted
+
+
+class SpmdChecker(Checker):
+    name = "spmd"
+    codes = {
+        "DS1200": (
+            "SPMD contract missing, malformed, or a declared closed form "
+            "is not statically evaluable"
+        ),
+        "DS1201": (
+            "ppermute table is not the declared permutation of the mesh "
+            "axis"
+        ),
+        "DS1202": (
+            "collective issued under a trace-divergent branch (or from a "
+            "host-only module)"
+        ),
+        "DS1203": (
+            "collective axis name does not resolve to a constructed mesh "
+            "axis"
+        ),
+        "DS1204": (
+            "remote DMA write regions in one kernel are not provably "
+            "disjoint"
+        ),
+    }
+    scope = ("dsort_tpu/*",)
+
+    def __init__(self, scope=None):
+        super().__init__(scope)
+        self._registry_memo: dict[str, tuple] = {}
+        self._axis_vocab_memo: dict[str, tuple] = {}
+
+    # -- shared plumbing ----------------------------------------------------
+
+    def _registry(self, ctx: FileContext):
+        """(registry dict | None, error Diagnostic | None), memoized."""
+        rel = ctx.config.spmd_registry_path.replace("\\", "/")
+        path = ctx.config.abspath(ctx.config.spmd_registry_path)
+        if path not in self._registry_memo:
+            try:
+                self._registry_memo[path] = (load_spmd_registry(path), None)
+            except ContractError as e:
+                self._registry_memo[path] = (
+                    None,
+                    Diagnostic(rel, e.lineno, 0, "DS1200", str(e)),
+                )
+        return self._registry_memo[path]
+
+    def _axis_vocab(self, ctx: FileContext, registry: dict) -> set[str]:
+        """Axis-name strings the mesh construction sources define."""
+        key = ctx.config.root
+        if key not in self._axis_vocab_memo:
+            vocab: set[str] = set()
+            for rel in registry["MESH_AXIS_SOURCES"]:
+                path = ctx.config.abspath(rel)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        tree = ast.parse(f.read(), filename=path)
+                except (OSError, SyntaxError):
+                    continue
+                for node in ast.walk(tree):
+                    targets: list[ast.expr] = []
+                    value = None
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif (
+                        isinstance(node, ast.AnnAssign)
+                        and node.value is not None
+                    ):
+                        targets, value = [node.target], node.value
+                    if not (
+                        isinstance(value, ast.Constant)
+                        and isinstance(value.value, str)
+                    ):
+                        continue
+                    for t in targets:
+                        if isinstance(t, ast.Name) and t.id.endswith(
+                            "axis_name"
+                        ):
+                            vocab.add(value.value)
+            self._axis_vocab_memo[key] = tuple(sorted(vocab))
+        return set(self._axis_vocab_memo[key])
+
+    # -- the pass -----------------------------------------------------------
+
+    def check(self, ctx: FileContext) -> list[Diagnostic]:
+        if ctx.tree is None:
+            return []
+        out: list[Diagnostic] = []
+        registry, reg_err = self._registry(ctx)
+        try:
+            contract, line = extract_contract(ctx.tree)
+        except ContractError as e:
+            return [Diagnostic(ctx.relpath, e.lineno, 0, "DS1200", str(e))]
+        required = (
+            ctx.relpath in registry["SPMD_REQUIRED"] if registry else False
+        )
+        if contract is None and not required:
+            return []
+        if reg_err is not None:
+            return [reg_err]
+        if contract is None:
+            return [
+                Diagnostic(
+                    ctx.relpath, 1, 0, "DS1200",
+                    "module is required to declare an SPMD_CONTRACT "
+                    "(analysis/spmd/registry.py SPMD_REQUIRED) but does not",
+                )
+            ]
+        bad_keys = sorted(set(contract) - {
+            "plane", "axis_param", "perms", "layouts", "caps", "stores",
+            "consts",
+        })
+        if bad_keys:
+            out.append(
+                Diagnostic(
+                    ctx.relpath, line, 0, "DS1200",
+                    f"SPMD_CONTRACT has unknown keys {bad_keys}",
+                )
+            )
+        plane = contract.get("plane")
+        if plane not in ("device", "host"):
+            out.append(
+                Diagnostic(
+                    ctx.relpath, line, 0, "DS1200",
+                    "SPMD_CONTRACT must declare plane: 'device' or 'host'",
+                )
+            )
+            return out
+        scans = [
+            _FnScan(fn)
+            for fn in ast.walk(ctx.tree)
+            if isinstance(fn, ast.FunctionDef)
+        ]
+        if plane == "host":
+            for scan in scans:
+                for call, _tests in scan.sites:
+                    out.append(
+                        Diagnostic(
+                            ctx.relpath, call.lineno, call.col_offset,
+                            "DS1202",
+                            f"collective {callee_basename(call.func)!r} "
+                            "issued from a module declared host-only "
+                            "(plane: 'host')",
+                        )
+                    )
+            return out
+        functions = extract_functions(ctx.tree)
+        perms = contract.get("perms", {})
+        out.extend(
+            self._check_required_minima(ctx, registry, line, perms, contract)
+        )
+        out.extend(self._check_perm_builders(ctx, registry, perms, functions))
+        axis_param = contract.get("axis_param", "axis")
+        declared = set(perms)
+        for scan in scans:
+            out.extend(
+                self._check_sites(
+                    ctx, registry, scan, axis_param, declared
+                )
+            )
+        out.extend(
+            self._check_layouts(
+                ctx, registry, contract.get("layouts", {}), functions, line
+            )
+        )
+        return out
+
+    def _check_required_minima(
+        self, ctx, registry, line, perms, contract
+    ) -> list[Diagnostic]:
+        out = []
+        for section, table in (
+            ("perms", registry["SPMD_REQUIRED_PERMS"]),
+            ("layouts", registry["SPMD_REQUIRED_LAYOUTS"]),
+        ):
+            needed = table.get(ctx.relpath, ())
+            have = contract.get(section, {})
+            for name in needed:
+                if name not in have:
+                    out.append(
+                        Diagnostic(
+                            ctx.relpath, line, 0, "DS1200",
+                            f"SPMD_CONTRACT must declare {section}[{name!r}] "
+                            "(analysis/spmd/registry.py minimum)",
+                        )
+                    )
+        return out
+
+    # -- DS1201: closed-form builders ---------------------------------------
+
+    def _check_perm_builders(
+        self, ctx, registry, perms, functions
+    ) -> list[Diagnostic]:
+        out = []
+        for name, spec in sorted(perms.items()):
+            fn = functions.get(name)
+            if fn is None:
+                out.append(
+                    Diagnostic(
+                        ctx.relpath, 1, 0, "DS1200",
+                        f"declared perm builder {name!r} not found at "
+                        "module top level",
+                    )
+                )
+                continue
+            if not isinstance(spec, dict):
+                out.append(
+                    Diagnostic(
+                        ctx.relpath, fn.lineno, 0, "DS1200",
+                        f"perms[{name!r}] must be a dict",
+                    )
+                )
+                continue
+            diag = self._verify_builder(ctx, registry, name, spec, fn)
+            if diag is not None:
+                out.append(diag)
+        return out
+
+    def _verify_builder(
+        self, ctx, registry, name, spec, fn
+    ) -> Diagnostic | None:
+        ev = Evaluator(extract_functions(ctx.tree))
+        args = spec.get("args")
+        domain = spec.get("domain")
+        kind = spec.get("kind")
+        axis_size = spec.get("axis_size")
+        if (
+            not isinstance(args, (list, tuple))
+            or not isinstance(domain, dict)
+            or kind not in ("full", "partial")
+            or not isinstance(axis_size, str)
+        ):
+            return Diagnostic(
+                ctx.relpath, fn.lineno, 0, "DS1200",
+                f"perms[{name!r}] needs args/domain/kind/axis_size",
+            )
+        try:
+            for env in iter_domain(domain, registry, ev):
+                p = ev.eval_str(axis_size, env)
+                pairs = ev.call(name, [env[a] for a in args])
+                bad = self._perm_violation(pairs, p, kind)
+                if bad is None and "dst" in spec:
+                    for src, dst in pairs:
+                        want = ev.eval_str(spec["dst"], {**env, "i": src})
+                        if dst != want:
+                            bad = (
+                                f"destination of source {src} is {dst}, "
+                                f"declared form gives {want}"
+                            )
+                            break
+                if bad is None and "pairs" in spec:
+                    want = ev.eval_str(spec["pairs"], env)
+                    if sorted(tuple(x) for x in pairs) != sorted(
+                        tuple(x) for x in want
+                    ):
+                        bad = "pair set differs from the declared closed form"
+                if bad is not None:
+                    at = ", ".join(f"{a}={env[a]}" for a in args)
+                    return Diagnostic(
+                        ctx.relpath, fn.lineno, 0, "DS1201",
+                        f"{name}({at}): {bad}",
+                    )
+        except EvalError as e:
+            return Diagnostic(
+                ctx.relpath, fn.lineno, 0, "DS1200",
+                f"perm builder {name!r} is not statically evaluable: {e}",
+            )
+        return None
+
+    @staticmethod
+    def _perm_violation(pairs, p, kind) -> str | None:
+        if not isinstance(pairs, (list, tuple)) or not all(
+            isinstance(x, (list, tuple))
+            and len(x) == 2
+            and all(isinstance(v, int) and not isinstance(v, bool) for v in x)
+            for x in pairs
+        ):
+            return "builder did not return a list of (src, dst) int pairs"
+        srcs = [s for s, _ in pairs]
+        dsts = [d for _, d in pairs]
+        for v in srcs + dsts:
+            if not 0 <= v < p:
+                return f"index {v} is outside the axis [0, {p})"
+        if len(set(srcs)) != len(srcs):
+            return "duplicate source (a device sends twice)"
+        if len(set(dsts)) != len(dsts):
+            return "duplicate destination (two devices write one slot)"
+        if kind == "full" and set(srcs) != set(range(p)):
+            return "missing source: table does not cover the axis"
+        return None
+
+    # -- DS1201/DS1202/DS1203: call sites ------------------------------------
+
+    def _check_sites(
+        self, ctx, registry, scan, axis_param, declared
+    ) -> list[Diagnostic]:
+        out = []
+        for call, tests in scan.sites:
+            base = callee_basename(call.func)
+            if base == "ppermute":
+                out.extend(self._check_perm_arg(ctx, scan, call, declared))
+            if base != "make_async_remote_copy":
+                out.extend(
+                    self._check_axis_arg(
+                        ctx, registry, call, axis_param
+                    )
+                )
+            for test in tests:
+                if _names_in(test) & scan.tainted:
+                    out.append(
+                        Diagnostic(
+                            ctx.relpath, call.lineno, call.col_offset,
+                            "DS1202",
+                            f"collective {base!r} under a branch on "
+                            "device-varying state "
+                            f"({scan.fn.name}): divergent participation "
+                            "deadlocks the mesh",
+                        )
+                    )
+                    break
+        for call, _tests in scan.conds:
+            if not call.args:
+                continue
+            if not (_names_in(call.args[0]) & scan.tainted):
+                continue
+            for branch in call.args[1:]:
+                body = None
+                if (
+                    isinstance(branch, ast.Name)
+                    and branch.id in scan.local_defs
+                ):
+                    body = scan.local_defs[branch.id]
+                elif isinstance(branch, ast.Lambda):
+                    body = branch
+                if body is None:
+                    continue
+                if any(
+                    isinstance(n, ast.Call)
+                    and callee_basename(n.func) in COLLECTIVES
+                    for n in ast.walk(body)
+                ):
+                    out.append(
+                        Diagnostic(
+                            ctx.relpath, call.lineno, call.col_offset,
+                            "DS1202",
+                            "collective inside a cond/switch branch on "
+                            f"device-varying state ({scan.fn.name}): "
+                            "divergent participation deadlocks the mesh",
+                        )
+                    )
+                    break
+        return out
+
+    def _check_perm_arg(self, ctx, scan, call, declared) -> list[Diagnostic]:
+        perm = None
+        if len(call.args) >= 3:
+            perm = call.args[2]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "perm":
+                    perm = kw.value
+        if perm is None:
+            return []
+        if isinstance(perm, ast.Call) and callee_basename(
+            perm.func
+        ) in declared:
+            return []
+        if isinstance(perm, ast.Name):
+            builders = scan.assign_calls.get(perm.id, [])
+            if builders and all(b in declared for b in builders):
+                return []
+        return [
+            Diagnostic(
+                ctx.relpath, call.lineno, call.col_offset, "DS1201",
+                "ppermute table does not trace to a declared closed-form "
+                f"builder ({scan.fn.name}); declare it in "
+                "SPMD_CONTRACT['perms'] so it is verified",
+            )
+        ]
+
+    def _check_axis_arg(
+        self, ctx, registry, call, axis_param
+    ) -> list[Diagnostic]:
+        axis = None
+        if len(call.args) >= 2:
+            axis = call.args[1]
+        else:
+            for kw in call.keywords:
+                if kw.arg in ("axis_name", "axis"):
+                    axis = kw.value
+        if axis is None:
+            return []
+        if isinstance(axis, ast.Name) and axis.id == axis_param:
+            return []
+        if isinstance(axis, ast.Constant) and isinstance(axis.value, str):
+            if axis.value in registry["MESH_AXES"]:
+                vocab = self._axis_vocab(ctx, registry)
+                if axis.value in vocab:
+                    return []
+                rel = ctx.config.spmd_registry_path.replace("\\", "/")
+                return [
+                    Diagnostic(
+                        rel, 1, 0, "DS1203",
+                        f"MESH_AXES declares {axis.value!r} but no mesh "
+                        "construction source defines that axis name",
+                    )
+                ]
+            return [
+                Diagnostic(
+                    ctx.relpath, call.lineno, call.col_offset, "DS1203",
+                    f"axis name {axis.value!r} is not in the constructed "
+                    "mesh vocabulary (analysis/spmd/registry.py MESH_AXES)",
+                )
+            ]
+        return [
+            Diagnostic(
+                ctx.relpath, call.lineno, call.col_offset, "DS1203",
+                "collective axis is neither the declared axis parameter "
+                f"({axis_param!r}) nor a literal mesh axis name",
+            )
+        ]
+
+    # -- DS1204: remote-DMA slot layout --------------------------------------
+
+    def _check_layouts(
+        self, ctx, registry, layouts, functions, cline
+    ) -> list[Diagnostic]:
+        out = []
+        if not isinstance(layouts, dict):
+            return [
+                Diagnostic(
+                    ctx.relpath, cline, 0, "DS1200",
+                    "SPMD_CONTRACT['layouts'] must be a dict",
+                )
+            ]
+        for name in sorted(layouts):
+            fn = functions.get(name)
+            if fn is None:
+                out.append(
+                    Diagnostic(
+                        ctx.relpath, 1, 0, "DS1200",
+                        f"declared kernel {name!r} not found at module "
+                        "top level",
+                    )
+                )
+                continue
+            out.extend(self._verify_layout(ctx, registry, fn))
+        return out
+
+    def _verify_layout(self, ctx, registry, fn) -> list[Diagnostic]:
+        sites = self._dma_sites(fn)
+        if sites is None:
+            return [
+                Diagnostic(
+                    ctx.relpath, fn.lineno, 0, "DS1200",
+                    f"kernel {fn.name!r}: a remote DMA destination is not "
+                    "of the provable NAME.at[pl.ds(start, size)] shape",
+                )
+            ]
+        if not sites:
+            return [
+                Diagnostic(
+                    ctx.relpath, fn.lineno, 0, "DS1200",
+                    f"declared kernel {fn.name!r} starts no remote DMA "
+                    "(stale layouts declaration?)",
+                )
+            ]
+        ev = Evaluator(extract_functions(ctx.tree))
+        for p in registry["MESH_SIZES"]:
+            caps = tuple(8 * (1 + (i * 3) % 4) for i in range(p))
+            env = {"num_workers": p, "caps": caps}
+            for st in fn.body:
+                if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                    t = st.targets[0]
+                    if isinstance(t, ast.Name):
+                        try:
+                            env[t.id] = ev.eval_expr(st.value, dict(env))
+                        except EvalError:
+                            pass
+            regions: dict[str, list] = {}
+            for buf, start, size, param, line, col in sites:
+                steps = range(p) if param is not None else range(1)
+                for k in steps:
+                    kenv = dict(env)
+                    if param is not None:
+                        kenv[param] = k
+                    if start is None:
+                        lo, ln = _WHOLE
+                    else:
+                        try:
+                            lo = ev.eval_expr(start, kenv)
+                            ln = ev.eval_expr(size, kenv)
+                        except EvalError as e:
+                            return [
+                                Diagnostic(
+                                    ctx.relpath, line, col, "DS1200",
+                                    f"kernel {fn.name!r}: DMA region not "
+                                    f"statically evaluable at P={p}: {e}",
+                                )
+                            ]
+                    if not (
+                        isinstance(lo, int) and isinstance(ln, int)
+                    ) or lo < 0 or ln < 0:
+                        return [
+                            Diagnostic(
+                                ctx.relpath, line, col, "DS1204",
+                                f"kernel {fn.name!r}: DMA region "
+                                f"[{lo}, +{ln}) at step {k} (P={p}) is "
+                                "negative or non-integer",
+                            )
+                        ]
+                    regions.setdefault(buf, []).append((lo, ln, k, line, col))
+            for buf, spans in regions.items():
+                spans = [s for s in spans if s[1] > 0]
+                spans.sort()
+                for a, b in zip(spans, spans[1:]):
+                    if b[0] < a[0] + a[1]:
+                        return [
+                            Diagnostic(
+                                ctx.relpath, b[3], b[4], "DS1204",
+                                f"kernel {fn.name!r}: remote DMA writes "
+                                f"into {buf!r} overlap at P={p}: step "
+                                f"{a[2]} region [{a[0]}, {a[0] + a[1]}) vs "
+                                f"step {b[2]} region [{b[0]}, "
+                                f"{b[0] + b[1]})",
+                            )
+                        ]
+        return []
+
+    @staticmethod
+    def _dma_sites(fn):
+        """[(buffer, start expr|None, size expr|None, index param|None,
+        line, col)] for every remote DMA under ``fn``; None when any
+        destination has an unprovable shape."""
+        sites = []
+
+        def enclosing_param(target):
+            param = None
+            stack = [(fn, None)]
+            while stack:
+                node, p = stack.pop()
+                for child in ast.iter_child_nodes(node):
+                    cp = p
+                    if isinstance(child, ast.FunctionDef):
+                        if len(child.args.args) == 1:
+                            cp = child.args.args[0].arg
+                        elif child.args.args:
+                            cp = "<multi>"
+                    if child is target:
+                        return cp
+                    stack.append((child, cp))
+            return param
+
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and callee_basename(node.func) == "make_async_remote_copy"
+            ):
+                continue
+            dst = None
+            for kw in node.keywords:
+                if kw.arg == "dst_ref":
+                    dst = kw.value
+            if dst is None and len(node.args) >= 2:
+                dst = node.args[1]
+            param = enclosing_param(node)
+            if param == "<multi>":
+                return None
+            if isinstance(dst, ast.Name):
+                sites.append(
+                    (dst.id, None, None, param, node.lineno, node.col_offset)
+                )
+                continue
+            if (
+                isinstance(dst, ast.Subscript)
+                and isinstance(dst.value, ast.Attribute)
+                and dst.value.attr == "at"
+                and isinstance(dst.slice, ast.Call)
+                and callee_basename(dst.slice.func) == "ds"
+                and len(dst.slice.args) == 2
+            ):
+                sites.append(
+                    (
+                        ast.unparse(dst.value.value),
+                        dst.slice.args[0],
+                        dst.slice.args[1],
+                        param,
+                        node.lineno,
+                        node.col_offset,
+                    )
+                )
+                continue
+            return None
+        return sites

@@ -148,6 +148,9 @@ class LintConfig:
     contracts_registry_path: str = os.path.join(
         "dsort_tpu", "analysis", "spec", "contracts.py"
     )
+    spmd_registry_path: str = os.path.join(
+        "dsort_tpu", "analysis", "spmd", "registry.py"
+    )
     layers: dict = dataclasses.field(default_factory=dict)
 
     def abspath(self, rel: str | None) -> str | None:
@@ -230,6 +233,8 @@ def load_config(root: str) -> LintConfig:
         cfg.spec_registry_path = table["spec_registry"]
     if "contracts_registry" in table:
         cfg.contracts_registry_path = table["contracts_registry"]
+    if "spmd_registry" in table:
+        cfg.spmd_registry_path = table["spmd_registry"]
     if "layers" in table:
         cfg.layers = {
             str(mod): tuple(forbidden)

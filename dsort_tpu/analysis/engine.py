@@ -210,6 +210,19 @@ class Registries:
         return self
 
 
+def _spmd_required_files(config: LintConfig) -> list[str]:
+    """Repo-relative files the SPMD verifier registry requires contracts
+    from (cache-key inputs; empty when the registry is absent/unreadable)."""
+    path = config.abspath(config.spmd_registry_path)
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return []
+    found = _tuple_literal_strs(tree, {"SPMD_REQUIRED"})
+    return found.get("SPMD_REQUIRED", [])
+
+
 # -- per-file result cache ---------------------------------------------------
 
 #: Bump when the cached-diagnostic shape or engine semantics change.
@@ -275,7 +288,21 @@ class ResultCache:
             config.registry_path, config.native_map_path,
             config.proto_registry_path, config.admission_registry_path,
             config.spec_registry_path, config.contracts_registry_path,
+            config.spmd_registry_path,
         ):
+            path = config.abspath(rel)
+            h.update(rel.encode())
+            try:
+                with open(path, "rb") as f:
+                    h.update(hashlib.sha256(f.read()).digest())
+            except OSError:
+                h.update(b"<missing>")
+        # The SPMD verifier proves OTHER modules' closed forms: every file
+        # the spmd registry requires a contract from participates in the
+        # key, so editing a perm builder or cap ladder in exchange.py
+        # invalidates every cached verdict (not just exchange.py's own —
+        # ring_kernel.py's layout proof evaluates exchange-derived caps).
+        for rel in sorted(_spmd_required_files(config)):
             path = config.abspath(rel)
             h.update(rel.encode())
             try:
@@ -356,17 +383,71 @@ def discover(paths: list[str]) -> list[str]:
     return files
 
 
+class LintStats:
+    """Per-checker cost/yield accounting for one `lint_paths` run.
+
+    ``checkers`` maps checker name -> ``{"seconds", "findings", "files",
+    "project"}`` — wall time inside the checker, pre-baseline finding
+    count, files handed to it (0 for a project pass, which runs once), and
+    whether it ran as the cross-file phase.  ``files``/``cached`` count the
+    run's inputs and cache hits; cache-served files charge no checker time,
+    so a warm run's table shows where the cold cost actually lives."""
+
+    def __init__(self):
+        self.files = 0
+        self.cached = 0
+        self.checkers: dict[str, dict] = {}
+
+    def add(self, name: str, seconds: float, findings: int, project: bool):
+        row = self.checkers.setdefault(
+            name,
+            {"seconds": 0.0, "findings": 0, "files": 0, "project": project},
+        )
+        row["seconds"] += seconds
+        row["findings"] += findings
+        if not project:
+            row["files"] += 1
+
+    def format(self) -> str:
+        rows = sorted(
+            self.checkers.items(),
+            key=lambda kv: -kv[1]["seconds"],
+        )
+        width = max([len("checker")] + [len(n) for n, _ in rows])
+        lines = [
+            f"{'checker':<{width}}  {'phase':<7}  {'files':>5}  "
+            f"{'findings':>8}  {'seconds':>8}",
+        ]
+        for name, row in rows:
+            phase = "project" if row["project"] else "file"
+            files = "-" if row["project"] else str(row["files"])
+            lines.append(
+                f"{name:<{width}}  {phase:<7}  {files:>5}  "
+                f"{row['findings']:>8}  {row['seconds']:>8.3f}"
+            )
+        total = sum(r["seconds"] for _, r in rows)
+        lines.append(
+            f"{len(self.checkers)} checker(s), {self.files} file(s) "
+            f"({self.cached} cache hit(s)), {total:.3f}s in checkers"
+        )
+        return "\n".join(lines) + "\n"
+
+
 def lint_paths(
     paths: list[str],
     config: LintConfig | None = None,
     checkers: list[Checker] | None = None,
     cache_path: str | None = None,
+    stats: LintStats | None = None,
 ) -> list[Diagnostic]:
     """Run ``checkers`` (default: all registered, minus config disables)
     over ``paths``; returns baseline- and suppression-filtered diagnostics
     sorted by (path, line, col, code).  ``cache_path`` enables the
     per-file result cache (the CLI's default; the API default stays
-    cache-free so tests and tools are hermetic)."""
+    cache-free so tests and tools are hermetic).  ``stats``, when given,
+    is filled with per-checker wall time and finding counts."""
+    import time
+
     from dsort_tpu.analysis.checkers import all_checkers
 
     config = config or LintConfig()
@@ -399,9 +480,13 @@ def lint_paths(
             source = f.read()
         rel_slash = rel.replace(os.sep, "/")
         relpaths.add(rel_slash)
+        if stats is not None:
+            stats.files += 1
         if cache is not None:
             cached = cache.get(rel_slash, source)
             if cached is not None:
+                if stats is not None:
+                    stats.cached += 1
                 diags.extend(
                     d for d in cached if d.baseline_key not in baseline
                 )
@@ -422,9 +507,14 @@ def lint_paths(
             for checker in file_checkers:
                 if not checker.matches(rel):
                     continue
-                raw.extend(
-                    d for d in checker.check(ctx) if not is_suppressed(d, supp)
-                )
+                t0 = time.perf_counter()
+                found = checker.check(ctx)
+                if stats is not None:
+                    stats.add(
+                        checker.name, time.perf_counter() - t0,
+                        len(found), project=False,
+                    )
+                raw.extend(d for d in found if not is_suppressed(d, supp))
         if cache is not None:
             cache.put(rel_slash, source, raw)
         diags.extend(d for d in raw if d.baseline_key not in baseline)
@@ -432,7 +522,14 @@ def lint_paths(
         project = ProjectContext(config, relpaths)
         supp_cache: dict[str, dict] = {}
         for checker in project_checkers:
-            for d in checker.check_project(project):
+            t0 = time.perf_counter()
+            found = checker.check_project(project)
+            if stats is not None:
+                stats.add(
+                    checker.name, time.perf_counter() - t0,
+                    len(found), project=True,
+                )
+            for d in found:
                 if d.path not in supp_cache:
                     src = project.source(d.path)
                     supp_cache[d.path] = suppressions(src) if src else {}
@@ -459,3 +556,60 @@ def format_text(diags: list[Diagnostic]) -> str:
 
 def format_json(diags: list[Diagnostic]) -> str:
     return json.dumps([d.to_dict() for d in diags], indent=1) + "\n"
+
+
+def format_sarif(diags: list[Diagnostic]) -> str:
+    """SARIF 2.1.0 log: one run, the full checker catalog as driver rules
+    (so code-scanning UIs show rule help even for clean runs), one result
+    per diagnostic.  Columns convert to SARIF's 1-based convention; paths
+    are already '/'-separated repo-relative URIs."""
+    from dsort_tpu.analysis.checkers import checker_catalog
+
+    rules = [
+        {
+            "id": code,
+            "name": code,
+            "shortDescription": {"text": desc},
+            "properties": {"checker": checker},
+        }
+        for checker, codes in sorted(checker_catalog().items())
+        for code, desc in sorted(codes.items())
+    ]
+    results = [
+        {
+            "ruleId": d.code,
+            "level": "error" if d.severity == "error" else "warning",
+            "message": {"text": d.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": d.path},
+                        "region": {
+                            "startLine": d.line,
+                            "startColumn": d.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        for d in diags
+    ]
+    log = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "dsort-lint",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(log, indent=1) + "\n"

@@ -1,0 +1,45 @@
+"""SPMD semantics verifier: the symbolic plane behind the DS12xx/DS13xx rules.
+
+The exchange variants are built on hand-derived ``ppermute`` tables, static
+receive-slot offsets, and a no-retry capacity doctrine ("ring-path overflow
+is an invariant violation") that — before this package — was enforced only
+at runtime by drills.  Schedule-synthesized redistribution (arXiv:2112.01075)
+treats collective schedules as verifiable objects; this package gives the
+lint pass the machinery to do the same statically:
+
+- `symeval`: a restricted, stdlib-only evaluator for the pure closed-form
+  functions the schedules are built from (perm builders, cap quantizers,
+  slot-offset cumsums).  It interprets the AST of THE TREE BEING LINTED —
+  never imports it — so the verdict is about what is written, not about an
+  installed copy, and linting never initializes a JAX backend.
+- `registry`: the pure-literal declaration registry — bounded verification
+  domains (mesh sizes, size samples, caps samples), the modules REQUIRED to
+  carry an ``SPMD_CONTRACT``, and the minimum each contract must declare
+  (so deleting a declaration cannot silence a proof — the same
+  no-vacuous-pass doctrine as the spec plane's DS1001).
+- `contract`: extraction of per-module ``SPMD_CONTRACT`` literals and the
+  domain-grid iteration shared by both checker families.
+
+The checkers themselves live in `dsort_tpu.analysis.checkers.spmd` (DS12xx,
+collective schedules) and `dsort_tpu.analysis.checkers.caps` (DS13xx,
+capacity/layout interval checks); ARCHITECTURE.md §19 documents the catalog
+and the honest limits of the bounded symbolic evaluation.
+"""
+
+from __future__ import annotations
+
+from dsort_tpu.analysis.spmd.contract import (
+    ContractError,
+    extract_contract,
+    load_spmd_registry,
+)
+from dsort_tpu.analysis.spmd.symeval import EvalError, Evaluator, extract_functions
+
+__all__ = [
+    "ContractError",
+    "EvalError",
+    "Evaluator",
+    "extract_contract",
+    "extract_functions",
+    "load_spmd_registry",
+]

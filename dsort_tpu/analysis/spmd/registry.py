@@ -1,0 +1,102 @@
+"""THE SPMD-verifier declaration registry (pure literals, parsed not run).
+
+Two things live here, both read by PARSING this file (`ast.literal_eval`),
+never by importing it — the same discipline as every other lint registry:
+
+1. **Bounded verification domains.**  The symbolic proofs are exhaustive
+   over these concrete grids (the small-scope doctrine the spec plane's
+   model checker established): mesh sizes ``P``, local-shard size samples,
+   and caps tuples for the slot-layout checks.  Growing a grid strengthens
+   every proof at once; the grids are part of the lint cache key, so
+   editing them invalidates cached verdicts.
+
+2. **Required declarations.**  Each module in `SPMD_REQUIRED` must carry a
+   top-level pure-literal ``SPMD_CONTRACT``; the per-file minima below pin
+   what that contract must at least declare.  This is the no-vacuous-pass
+   doctrine: deleting a contract (or one entry of it) to silence a proof
+   is itself a DS1200/DS1300 finding, so the seeded-mutation gates cannot
+   be dodged by removing the declaration they check against.
+
+The lint `ResultCache` hashes this file AND every source it names into the
+config key (`engine.ResultCache._config_key`): editing a closed form in
+``exchange.py`` invalidates every cached verdict in the tree.
+"""
+
+#: Mesh-axis sizes ``P`` the permutation/layout proofs instantiate.  Covers
+#: the degenerate 1-device mesh, primes (no host grouping), and the
+#: composite sizes the hierarchical plane actually groups (H x D).
+MESH_SIZES = (1, 2, 3, 4, 6, 8)
+
+#: Local-shard sizes ``n_local`` the capacity proofs sweep measured maxes
+#: over (the sweep stride adapts; edges are always included).
+SIZE_SAMPLES = (8, 64, 100, 1000, 4096, 100000)
+
+#: Caps tuples driving the slot-offset/cumsum layout proofs.  Mixed rungs,
+#: a zero-length slot, and a single-slot degenerate all participate.
+CAPS_SAMPLES = (
+    (8,),
+    (8, 16),
+    (8, 0, 16),
+    (8, 16, 8, 24),
+    (16, 8, 8, 32, 8, 40, 8, 8),
+)
+
+#: Modules that MUST declare a top-level ``SPMD_CONTRACT``.
+SPMD_REQUIRED = (
+    "dsort_tpu/parallel/exchange.py",
+    "dsort_tpu/parallel/coded.py",
+    "dsort_tpu/ops/ring_kernel.py",
+    "dsort_tpu/models/pipelines.py",
+    "dsort_tpu/obs/plan.py",
+)
+
+#: Per-file minimum ``perms`` declarations (DS12xx): the closed-form
+#: ppermute builders that must stay declared and proven.
+SPMD_REQUIRED_PERMS = {
+    "dsort_tpu/parallel/exchange.py": (
+        "_ring_perm",
+        "_hier_perm_intra",
+        "_hier_perm_leg",
+    ),
+}
+
+#: Per-file minimum ``layouts`` declarations (DS1204): fused kernels whose
+#: remote-DMA write regions must stay provably disjoint.
+SPMD_REQUIRED_LAYOUTS = {
+    "dsort_tpu/ops/ring_kernel.py": (
+        "_fused_ring_kernel",
+        "_fused_ring_kv_kernel",
+    ),
+}
+
+#: Per-file minimum ``caps`` declarations (DS13xx).
+SPMD_REQUIRED_CAPS = {
+    "dsort_tpu/parallel/exchange.py": (
+        "ring_step_quantum",
+        "_quantize_cap",
+        "ladder_rungs",
+        "parity_slots",
+        "resolve_redundancy",
+    ),
+    "dsort_tpu/ops/ring_kernel.py": ("_step_offsets",),
+    "dsort_tpu/models/pipelines.py": ("pad_rung",),
+}
+
+#: Per-file minimum ``stores`` declarations (DS1302): receive-canvas writes
+#: that must keep their declared re-pack hop.
+SPMD_REQUIRED_STORES = {
+    "dsort_tpu/parallel/exchange.py": ("_hier_exchange_shard",),
+}
+
+#: Per-file minimum ``consts`` declarations (DS1303 clamp chains).
+SPMD_REQUIRED_CONSTS = {
+    "dsort_tpu/obs/plan.py": ("WAVE_MIN_ELEMS", "WAVE_MAX_ELEMS"),
+}
+
+#: Mesh-axis-name vocabulary collectives may name literally (DS1203), and
+#: the sources whose mesh-construction defaults must actually define each
+#: name — `parallel.mesh.make_mesh` builds its ``Mesh`` from these config
+#: fields, so an axis in this tuple IS an axis some mesh is constructed
+#: with.
+MESH_AXES = ("w", "dp")
+MESH_AXIS_SOURCES = ("dsort_tpu/config.py",)

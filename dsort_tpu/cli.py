@@ -3170,7 +3170,9 @@ def cmd_lint(args) -> int:
     1 = findings.
     """
     from dsort_tpu.analysis import (
+        LintStats,
         format_json,
+        format_sarif,
         format_text,
         lint_paths,
         load_config,
@@ -3234,10 +3236,16 @@ def cmd_lint(args) -> int:
         write_baseline(path, diags)
         log.info("baseline written to %s (%d entries)", path, len(diags))
         return 0
-    diags = lint_paths(paths, cfg, cache_path=cache_path)
-    sys.stdout.write(
-        format_json(diags) if args.format == "json" else format_text(diags)
+    stats = LintStats() if args.stats else None
+    diags = lint_paths(paths, cfg, cache_path=cache_path, stats=stats)
+    formatter = {"json": format_json, "sarif": format_sarif}.get(
+        args.format, format_text
     )
+    sys.stdout.write(formatter(diags))
+    if stats is not None:
+        # Stats go to stderr so `--format sarif > out.sarif` stays a valid
+        # SARIF document with the table still visible.
+        sys.stderr.write(stats.format())
     return 1 if any(d.severity == "error" for d in diags) else 0
 
 
@@ -3797,7 +3805,13 @@ def main(argv=None) -> int:
     )
     p.add_argument("paths", nargs="*",
                    help="files/directories to check (default: dsort_tpu/)")
-    p.add_argument("--format", default="text", choices=["text", "json"])
+    p.add_argument("--format", default="text",
+                   choices=["text", "json", "sarif"],
+                   help="output format (sarif: SARIF 2.1.0 for "
+                        "code-scanning upload)")
+    p.add_argument("--stats", action="store_true",
+                   help="print a per-checker wall-time/findings table "
+                        "(file vs project phase) to stderr")
     p.add_argument("--baseline",
                    help="baseline JSON path (default from [tool.dsort.lint])")
     p.add_argument("--write-baseline", action="store_true",

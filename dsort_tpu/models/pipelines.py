@@ -39,6 +39,38 @@ def local_pipeline(shards: jax.Array, counts: jax.Array):
 local_pipeline_step = jax.jit(local_pipeline)
 
 
+#: SPMD-verifier contract (parsed, not imported — `dsort_tpu.analysis.spmd`).
+#: The driver layer is host-plane: it builds meshes and calls shard
+#: programs but must never issue a mesh collective itself (DS1202).
+#: ``pad_rung`` is the fused path's compile-size quantizer — the DS1301
+#: covering proof (``pad_rung(n) >= n``) is what makes "pad to the rung"
+#: safe, and the rung-step bound keeps the pad waste inside one ladder
+#: step.
+SPMD_CONTRACT = {
+    "plane": "host",
+    "caps": {
+        "pad_rung": {
+            "args": ("n",),
+            "domain": {
+                "n": (
+                    "list(range(1, 1025))"
+                    " + [4096, 4097, (1 << 20) - 3, 1 << 20]"
+                ),
+            },
+            "require": (
+                ("DS1301", "out >= n"),
+                ("DS1303", "out >= 8"),
+                ("DS1303", "out % 8 == 0"),
+                (
+                    "DS1301",
+                    "out - n"
+                    " < max(8, 1 << max((n - 1).bit_length() - 3, 0))",
+                ),
+            ),
+        },
+    },
+}
+
 #: Jobs strictly below this many keys auto-route to `fused_sort_small` in
 #: the CLI's spmd mode: the SPMD driver's ~7 host<->device dispatches
 #: dominate jobs this small (each costs ~70-100 ms through a relay tunnel),

@@ -51,6 +51,25 @@ PLAN_DECISION_FIELDS = ("policy", "chosen", "inputs", "rejected")
 #: Fields every ``plan_override`` event carries.
 PLAN_OVERRIDE_FIELDS = ("policy", "explicit", "planned", "inputs")
 
+#: SPMD-verifier contract (parsed, not imported — `dsort_tpu.analysis.spmd`).
+#: The planner is host-plane (DS1202: no collectives), and its wave clamp
+#: must stay a non-degenerate ordered 8-aligned window — the wave sizer
+#: clamps into ``[WAVE_MIN_ELEMS, WAVE_MAX_ELEMS]``, so an inverted or
+#: unaligned window would produce zero-size (or tile-misaligned) waves.
+SPMD_CONTRACT = {
+    "plane": "host",
+    "consts": {
+        "WAVE_MIN_ELEMS": (
+            ("DS1303", "value >= 8"),
+            ("DS1303", "value % 8 == 0"),
+        ),
+        "WAVE_MAX_ELEMS": (
+            ("DS1303", "value % 8 == 0"),
+            ("DS1303", "value >= WAVE_MIN_ELEMS"),
+        ),
+    },
+}
+
 # -- policy constants (the documented thresholds of ARCHITECTURE §15) --------
 
 #: Plan-phase skew ratio (``max_mean_ratio``) at or above which the

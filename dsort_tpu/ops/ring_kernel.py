@@ -85,6 +85,36 @@ __all__ = [
     "fused_ring_exchange_kv_shard",
 ]
 
+#: SPMD-verifier contract (parsed, not imported — `dsort_tpu.analysis.spmd`).
+#: ``layouts`` puts both fused kernels under the DS1204 remote-DMA proof:
+#: every ``pl.ds(offs[k], caps[k])`` write region is re-derived from the
+#: kernel's own offset arithmetic and checked pairwise disjoint per output
+#: buffer; ``caps`` pins ``_step_offsets`` to the exact partial-sum layout
+#: the kv tag plane indexes.
+SPMD_CONTRACT = {
+    "plane": "device",
+    "axis_param": "axis",
+    "layouts": {
+        "_fused_ring_kernel": {},
+        "_fused_ring_kv_kernel": {},
+    },
+    "caps": {
+        "_step_offsets": {
+            "args": ("caps",),
+            "domain": {"caps": "CAPS_SAMPLES"},
+            "require": (
+                ("DS1302", "out[0] == 0"),
+                ("DS1302", "len(out) == len(caps) + 1"),
+                (
+                    "DS1302",
+                    "all(out[i + 1] == out[i] + caps[i]"
+                    " for i in range(len(caps)))",
+                ),
+            ),
+        },
+    },
+}
+
 
 def fused_mesh(mesh, axis: str):
     """A 1-axis view of the worker axis for the fused kernel's dispatch.

@@ -45,6 +45,12 @@ import threading
 
 import numpy as np
 
+#: SPMD-verifier contract (parsed, not imported — `dsort_tpu.analysis.spmd`).
+#: This module is the coded exchange's HOST bookkeeping plane (claim
+#: journals, recovery solves); issuing a mesh collective from here would be
+#: a layering break, and the DS1202 host-plane rule makes it a lint error.
+SPMD_CONTRACT = {"plane": "host"}
+
 __all__ = [
     "CodedBudgetExceeded",
     "CodedExchangeState",

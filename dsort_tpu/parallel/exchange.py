@@ -88,6 +88,148 @@ __all__ = [
     "HierPlan",
 ]
 
+#: The SPMD-verifier contract (pure literal, read by PARSING this module —
+#: `dsort_tpu.analysis.spmd`).  Declares every closed-form ppermute builder
+#: with the destination form it must compute, and every capacity function
+#: with the properties it must satisfy; the `spmd`/`caps` lint checkers
+#: PROVE the declarations over the bounded grids in
+#: `analysis/spmd/registry.py` on every lint run.  `ring_caps`/
+#: `host_matrix`/`hier_plan` are numpy-bound and therefore outside the
+#: symbolic subset: their covering property follows from `_quantize_cap`
+#: (verified below), which they delegate every quantization to.
+SPMD_CONTRACT = {
+    "plane": "device",
+    "axis_param": "axis",
+    "perms": {
+        "_ring_perm": {
+            "args": ("num_workers", "k"),
+            "domain": {"num_workers": "MESH", "k": "range(num_workers)"},
+            "kind": "full",
+            "axis_size": "num_workers",
+            "dst": "(i + k) % num_workers",
+        },
+        "_hier_perm_intra": {
+            "args": ("num_workers", "dev_per_host", "k"),
+            "domain": {
+                "num_workers": "MESH",
+                "dev_per_host": (
+                    "[d for d in range(1, num_workers + 1)"
+                    " if num_workers % d == 0]"
+                ),
+                "k": "range(dev_per_host)",
+            },
+            "kind": "full",
+            "axis_size": "num_workers",
+            "dst": (
+                "(i // dev_per_host) * dev_per_host"
+                " + ((i % dev_per_host + k) % dev_per_host)"
+            ),
+        },
+        "_hier_perm_leg": {
+            "args": ("num_workers", "hosts", "shift"),
+            "domain": {
+                "num_workers": "MESH",
+                "hosts": (
+                    "[h for h in range(1, num_workers + 1)"
+                    " if num_workers % h == 0]"
+                ),
+                "shift": "range(hosts)",
+            },
+            "kind": "partial",
+            "axis_size": "num_workers",
+            "pairs": (
+                "[(g * (num_workers // hosts)"
+                " + ((g + shift) % hosts) % (num_workers // hosts),"
+                " ((g + shift) % hosts) * (num_workers // hosts)"
+                " + g % (num_workers // hosts))"
+                " for g in range(hosts)]"
+            ),
+        },
+    },
+    "caps": {
+        "ring_step_quantum": {
+            "args": ("n_local", "num_workers"),
+            "domain": {"num_workers": "MESH", "n_local": "SIZES"},
+            "require": (
+                ("DS1303", "out >= 8"),
+                ("DS1303", "out % 8 == 0"),
+                (
+                    "DS1301",
+                    "out <= ((max(n_local // (8 * num_workers), 8) + 7)"
+                    " // 8) * 8",
+                ),
+            ),
+        },
+        "_quantize_cap": {
+            "args": ("max_len", "n_local", "num_workers"),
+            "domain": {
+                "num_workers": "MESH",
+                "n_local": "SIZES",
+                "max_len": (
+                    "[m for m in [0]"
+                    " + [x * max(1, n_local // 31) for x in range(32)]"
+                    " + [n_local] if m <= n_local]"
+                ),
+            },
+            "require": (
+                ("DS1301", "out >= max_len"),
+                ("DS1303", "out >= 8"),
+                ("DS1303", "out % 8 == 0"),
+                (
+                    "DS1303",
+                    "out % ring_step_quantum(n_local, num_workers) == 0"
+                    " or out == max(((n_local + 7) // 8) * 8, 8)",
+                ),
+            ),
+        },
+        "ladder_rungs": {
+            "args": ("hi", "lo"),
+            "domain": {"hi": "SIZES", "lo": "(8, 64)"},
+            "require": (
+                ("DS1303", "all(r >= 8 for r in out)"),
+                ("DS1303", "all(r % 8 == 0 for r in out)"),
+                (
+                    "DS1302",
+                    "all(out[i] < out[i + 1]"
+                    " for i in range(len(out) - 1))",
+                ),
+                (
+                    "DS1301",
+                    "hi < lo or (len(out) > 0 and out[-1] <= hi"
+                    " and out[-1]"
+                    " + max(8, 1 << max(out[-1].bit_length() - 3, 0))"
+                    " > hi)",
+                ),
+            ),
+        },
+        "parity_slots": {
+            "args": ("redundancy",),
+            "domain": {"redundancy": "range(1, 12)"},
+            "require": (
+                ("DS1303", "0 <= out <= 2"),
+                ("DS1301", "out >= min(redundancy - 1, 2)"),
+            ),
+        },
+        "resolve_redundancy": {
+            "args": ("value", "default", "num_workers"),
+            "domain": {
+                "num_workers": "MESH",
+                "default": "(1, 2)",
+                "value": "[None] + list(range(1, 9))",
+            },
+            "require": (
+                ("DS1303", "1 <= out"),
+                ("DS1303", "out <= max(num_workers, 1)"),
+            ),
+        },
+    },
+    "stores": {
+        "_hier_exchange_shard": (
+            {"canvas": "rcv", "repack": "_pad_run", "width": "agg_total"},
+        ),
+    },
+}
+
 
 def resolve_exchange(value: str | None, default: str, num_workers: int) -> str:
     """THE exchange-schedule resolver, shared by every driver: per-call

@@ -1,0 +1,73 @@
+"""Deliberate DS13xx violations (capacity/layout abstract interpreter).
+
+Expected findings (test-pinned):
+- DS1300 x2: ``caps['lost_fn']`` declared but no such function; a declared
+  cap function that calls numpy (outside the evaluable subset).
+- DS1301 x1: a quantizer that rounds DOWN (cap does not cover demand).
+- DS1302 x1: a receive-canvas store without the declared re-pack hop.
+- DS1303 x3: a quantum off the 8 grid (two failed properties) and an
+  inverted clamp window constant.
+"""
+
+import numpy as np
+
+SPMD_CONTRACT = {
+    "plane": "host",
+    "caps": {
+        "shrink_cap": {
+            "args": ("m",),
+            "domain": {"m": "SIZES"},
+            "require": (("DS1301", "out >= m"),),
+        },
+        "odd_quantum": {
+            "args": ("n",),
+            "domain": {"n": "SIZES"},
+            "require": (
+                ("DS1303", "out >= 8"),
+                ("DS1303", "out % 8 == 0"),
+            ),
+        },
+        "lost_fn": {
+            "args": ("n",),
+            "domain": {"n": "SIZES"},
+            "require": (("DS1301", "out >= n"),),
+        },
+        "numpy_cap": {
+            "args": ("n",),
+            "domain": {"n": "SIZES"},
+            "require": (("DS1301", "out >= n"),),
+        },
+    },
+    "stores": {
+        "weave": ({"canvas": "rcv", "repack": "_pad_run", "width": "total"},),
+    },
+    "consts": {
+        "MIN_WINDOW": (("DS1303", "value <= MAX_WINDOW"),),
+    },
+}
+
+MIN_WINDOW = 1 << 20
+MAX_WINDOW = 1 << 16  # inverted: clamp(lo=MIN, hi=MAX) collapses to MAX
+
+
+def shrink_cap(m):
+    return m - (m % 16)
+
+
+def odd_quantum(n):
+    return max(n // 12, 3)
+
+
+def numpy_cap(n):
+    return int(np.ceil(n / 8.0)) * 8
+
+
+def _pad_run(buf, width, fill):
+    return buf
+
+
+def weave(rcv, rbuf, total, sent, row):
+    # The re-pack hop is missing: a short leg buffer lands in a
+    # total-wide row unpadded.
+    rcv = rcv.at[row].set(rbuf)
+    return rcv

@@ -1,0 +1,92 @@
+"""Deliberate DS12xx violations (SPMD collective-schedule verifier).
+
+Expected findings (test-pinned):
+- DS1200 x1: ``perms['missing_builder']`` declared but no such function.
+- DS1201 x3: ``shift_perm`` computes the INVERTED shift (valid bijection,
+  wrong declared form); ``collide_perm`` maps two sources to one
+  destination at P >= 3; one ``ppermute`` call site whose table traces to
+  an undeclared builder.
+- DS1202 x2: a ``psum`` under an ``if`` on ``axis_index``-derived state,
+  and a collective inside a ``lax.cond`` branch on such a predicate.
+- DS1203 x1: an ``all_gather`` naming an axis no mesh constructs.
+- DS1204 x1: a kernel whose remote-DMA write regions overlap.
+"""
+
+import jax
+
+SPMD_CONTRACT = {
+    "plane": "device",
+    "axis_param": "axis",
+    "perms": {
+        "shift_perm": {
+            "args": ("p", "k"),
+            "domain": {"p": "MESH", "k": "range(p)"},
+            "kind": "full",
+            "axis_size": "p",
+            "dst": "(i + k) % p",
+        },
+        "collide_perm": {
+            "args": ("p",),
+            "domain": {"p": "MESH"},
+            "kind": "full",
+            "axis_size": "p",
+        },
+        "missing_builder": {
+            "args": ("p",),
+            "domain": {"p": "MESH"},
+            "kind": "full",
+            "axis_size": "p",
+        },
+    },
+    "layouts": {"bad_kernel": {}},
+}
+
+
+def shift_perm(p, k):
+    # Declared dst is (i + k) % p; this is the inverted ring.
+    return [(i, (i - k) % p) for i in range(p)]
+
+
+def collide_perm(p):
+    return [(i, min(i, 1)) for i in range(p)]
+
+
+def exchange(x, lens, axis, p):
+    me = jax.lax.axis_index(axis)
+    table = build_table(p)  # noqa: F821 - undeclared builder, AST-only
+    out = jax.lax.ppermute(x, axis, table)
+    if me > 0:
+        out = jax.lax.psum(out, axis)
+    out = jax.lax.cond(
+        me > 0, lambda: jax.lax.psum(x, axis), lambda: x
+    )
+    y = jax.lax.all_gather(lens, "q")
+    return out, y
+
+
+def _off(caps):
+    offs = [0]
+    for c in caps:
+        offs.append(offs[-1] + int(c))
+    return offs
+
+
+def bad_kernel(*refs, num_workers, caps, axis):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p = num_workers
+    out_ref = refs[p]
+    offs = _off(caps)
+    me = jax.lax.axis_index(axis)
+
+    def copy(k):
+        # Halved offsets: step k's slot overlaps step k-1's tail.
+        return pltpu.make_async_remote_copy(
+            src_ref=refs[k],
+            dst_ref=out_ref.at[pl.ds(offs[k] // 2, caps[k])],
+            device_id=me,
+        )
+
+    for k in range(1, p):
+        copy(k).start()

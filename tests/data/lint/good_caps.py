@@ -1,0 +1,54 @@
+"""Clean twin of ``bad_caps.py`` — same shapes, zero findings.
+
+The quantizer rounds UP on the 8 grid (covering + aligned), the canvas
+store keeps its declared re-pack hop, and the clamp window is ordered.
+"""
+
+SPMD_CONTRACT = {
+    "plane": "host",
+    "caps": {
+        "grow_cap": {
+            "args": ("m",),
+            "domain": {"m": "SIZES"},
+            "require": (
+                ("DS1301", "out >= m"),
+                ("DS1303", "out >= 8"),
+                ("DS1303", "out % 8 == 0"),
+            ),
+        },
+        "even_quantum": {
+            "args": ("n",),
+            "domain": {"n": "SIZES"},
+            "require": (
+                ("DS1303", "out >= 8"),
+                ("DS1303", "out % 8 == 0"),
+            ),
+        },
+    },
+    "stores": {
+        "weave": ({"canvas": "rcv", "repack": "_pad_run", "width": "total"},),
+    },
+    "consts": {
+        "MIN_WINDOW": (("DS1303", "value <= MAX_WINDOW"),),
+    },
+}
+
+MIN_WINDOW = 1 << 16
+MAX_WINDOW = 1 << 20
+
+
+def grow_cap(m):
+    return max(-(-m // 8) * 8, 8)
+
+
+def even_quantum(n):
+    return max(-(-max(n // 96, 8) // 8) * 8, 8)
+
+
+def _pad_run(buf, width, fill):
+    return buf
+
+
+def weave(rcv, rbuf, total, sent, row):
+    rcv = rcv.at[row].set(_pad_run(rbuf, total, sent))
+    return rcv

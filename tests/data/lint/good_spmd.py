@@ -1,0 +1,78 @@
+"""Clean twin of ``bad_spmd.py`` — same shapes, zero findings.
+
+The builders match their declared closed forms, every ppermute table
+traces to a declared builder, branches on device-varying state issue no
+collectives, the literal axis name is a constructed mesh axis, and the
+kernel's remote-DMA slots are the disjoint partial-sum layout.
+"""
+
+import jax
+
+SPMD_CONTRACT = {
+    "plane": "device",
+    "axis_param": "axis",
+    "perms": {
+        "shift_perm": {
+            "args": ("p", "k"),
+            "domain": {"p": "MESH", "k": "range(p)"},
+            "kind": "full",
+            "axis_size": "p",
+            "dst": "(i + k) % p",
+        },
+        "pair_perm": {
+            "args": ("p", "k"),
+            "domain": {"p": "MESH", "k": "range(p)"},
+            "kind": "full",
+            "axis_size": "p",
+            "pairs": "[(i, (i + k) % p) for i in range(p)]",
+        },
+    },
+    "layouts": {"good_kernel": {}},
+}
+
+
+def shift_perm(p, k):
+    return [(i, (i + k) % p) for i in range(p)]
+
+
+def pair_perm(p, k):
+    return [(i, (i + k) % p) for i in range(p)]
+
+
+def exchange(x, lens, axis, p, eager):
+    me = jax.lax.axis_index(axis)
+    out = jax.lax.ppermute(x, axis, shift_perm(p, 1))
+    table = pair_perm(p, 2)
+    out = jax.lax.ppermute(out, axis, table)
+    if eager:  # config flag, not device-varying: branching is uniform
+        out = jax.lax.psum(out, axis)
+    keep = jax.lax.cond(me > 0, lambda: x, lambda: out)
+    y = jax.lax.all_gather(lens, "w")
+    return keep, y
+
+
+def _off(caps):
+    offs = [0]
+    for c in caps:
+        offs.append(offs[-1] + int(c))
+    return offs
+
+
+def good_kernel(*refs, num_workers, caps, axis):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p = num_workers
+    out_ref = refs[p]
+    offs = _off(caps)
+    me = jax.lax.axis_index(axis)
+
+    def copy(k):
+        return pltpu.make_async_remote_copy(
+            src_ref=refs[k],
+            dst_ref=out_ref.at[pl.ds(offs[k], caps[k])],
+            device_id=me,
+        )
+
+    for k in range(1, p):
+        copy(k).start()

@@ -299,6 +299,7 @@ def test_checker_catalog_is_documented():
     assert set(catalog) == {
         "registry", "concurrency", "tracing", "exceptions", "compat",
         "layers", "durability", "protocol", "lifecycle", "spec",
+        "spmd", "caps",
     }
     arch = open(os.path.join(REPO, "ARCHITECTURE.md"), encoding="utf-8").read()
     for codes in catalog.values():

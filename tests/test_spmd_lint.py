@@ -1,0 +1,288 @@
+"""SPMD semantics verifier tests (`dsort_tpu.analysis.spmd`, DS12xx/DS13xx).
+
+Four layers of gates:
+
+1. Fixture pairs: ``bad_spmd.py``/``bad_caps.py`` must produce exactly the
+   pinned per-code counts; the ``good_*`` near-miss twins produce none.
+2. Seeded-mutation gates (the cross-check contract): re-introducing an
+   inverted ring shift, deleting the hier DCN re-pack hop, or knocking
+   ``ring_step_quantum`` off the 8 grid in a COPY of the real tree must
+   each be caught statically — and the unmutated copy stays clean, as does
+   a copy whose ``SPMD_CONTRACT`` is deleted (no-vacuous-pass: the
+   registry minima make the missing declaration itself a finding).
+3. Differential: the restricted evaluator must agree with the imported
+   functions on the bounded grids (the proofs are about THIS arithmetic).
+4. Engine satellites: the cache key tracks the spmd registry's required
+   sources, SARIF output round-trips, ``--stats`` accounts per checker,
+   and a warm cached whole-tree lint stays interactive.
+"""
+
+import ast
+import json
+import os
+import shutil
+import time
+from collections import Counter
+
+from dsort_tpu.analysis import (
+    LintConfig,
+    LintStats,
+    format_sarif,
+    lint_paths,
+    load_config,
+)
+from dsort_tpu.analysis.checkers import all_checkers
+from dsort_tpu.analysis.checkers.caps import CapsChecker
+from dsort_tpu.analysis.checkers.spmd import SpmdChecker
+from dsort_tpu.analysis.engine import ResultCache
+from dsort_tpu.analysis.spmd import Evaluator, extract_functions
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "lint")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def run_fixture(name: str):
+    # Fixtures live outside the checkers' default dsort_tpu/ scope (the
+    # shipped-tree gate must not see them), so tests rescope.
+    cfg = LintConfig(root=REPO)
+    return lint_paths(
+        [fixture(name)],
+        cfg,
+        checkers=[SpmdChecker(scope=("*",)), CapsChecker(scope=("*",))],
+    )
+
+
+# -- fixture pairs -----------------------------------------------------------
+
+
+def test_bad_spmd_fixture_counts():
+    counts = Counter(d.code for d in run_fixture("bad_spmd.py"))
+    assert counts == {
+        "DS1200": 1, "DS1201": 3, "DS1202": 2, "DS1203": 1, "DS1204": 1,
+    }
+
+
+def test_good_spmd_fixture_clean():
+    assert run_fixture("good_spmd.py") == []
+
+
+def test_bad_caps_fixture_counts():
+    counts = Counter(d.code for d in run_fixture("bad_caps.py"))
+    assert counts == {"DS1300": 2, "DS1301": 1, "DS1302": 1, "DS1303": 3}
+
+
+def test_good_caps_fixture_clean():
+    assert run_fixture("good_caps.py") == []
+
+
+def test_host_plane_collective_flagged(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        'SPMD_CONTRACT = {"plane": "host"}\n'
+        "import jax\n\n\n"
+        "def f(x, axis):\n"
+        "    return jax.lax.psum(x, axis)\n"
+    )
+    diags = lint_paths(
+        [str(src)],
+        LintConfig(root=REPO),
+        checkers=[SpmdChecker(scope=("*",))],
+    )
+    assert [d.code for d in diags] == ["DS1202"]
+
+
+# -- seeded-mutation gates on a copy of the real tree ------------------------
+
+#: Files the copied verification tree needs: the registry, the mesh-axis
+#: vocabulary source, and the module under mutation.
+_TREE_FILES = (
+    "dsort_tpu/analysis/spmd/registry.py",
+    "dsort_tpu/config.py",
+    "dsort_tpu/parallel/exchange.py",
+)
+
+
+def _copy_tree(tmp_path, old=None, new=None):
+    for rel in _TREE_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(REPO, rel), dst)
+    ex = tmp_path / "dsort_tpu" / "parallel" / "exchange.py"
+    if old is not None:
+        text = ex.read_text()
+        assert old in text, f"mutation anchor drifted: {old!r}"
+        ex.write_text(text.replace(old, new, 1))
+    return str(ex)
+
+
+def _lint_copy(tmp_path):
+    return lint_paths(
+        [str(tmp_path / "dsort_tpu" / "parallel" / "exchange.py")],
+        LintConfig(root=str(tmp_path)),
+        checkers=[SpmdChecker(), CapsChecker()],
+    )
+
+
+def test_clean_copy_has_no_findings(tmp_path):
+    _copy_tree(tmp_path)
+    assert _lint_copy(tmp_path) == []
+
+
+def test_mutation_inverted_ring_shift_is_caught(tmp_path):
+    _copy_tree(
+        tmp_path,
+        "(i, (i + k) % num_workers)",
+        "(i, (i - k) % num_workers)",
+    )
+    diags = _lint_copy(tmp_path)
+    assert "DS1201" in {d.code for d in diags}
+    assert any("_ring_perm" in d.message for d in diags)
+
+
+def test_mutation_deleted_repack_hop_is_caught(tmp_path):
+    _copy_tree(tmp_path, "_pad_run(rbuf, agg_total, sent)", "rbuf")
+    diags = _lint_copy(tmp_path)
+    assert "DS1302" in {d.code for d in diags}
+    assert any("_hier_exchange_shard" in d.message for d in diags)
+
+
+def test_mutation_offgrid_quantum_is_caught(tmp_path):
+    _copy_tree(
+        tmp_path,
+        "return max(-(-max(n_local // (8 * num_workers), 8) // 8) * 8, 8)",
+        "return max(n_local // (8 * num_workers), 12)",
+    )
+    diags = _lint_copy(tmp_path)
+    codes = {d.code for d in diags}
+    assert "DS1303" in codes
+    assert any("ring_step_quantum" in d.message for d in diags)
+
+
+def test_deleted_contract_is_itself_a_finding(tmp_path):
+    # No-vacuous-pass: silencing the proofs by removing the declaration
+    # they check against is a DS1200 (the registry minima pin the file).
+    _copy_tree(tmp_path, "SPMD_CONTRACT = {", "SPMD_CONTRACT_DISABLED = {")
+    diags = _lint_copy(tmp_path)
+    assert "DS1200" in {d.code for d in diags}
+
+
+def test_shipped_tree_has_no_spmd_findings():
+    # The no-findings gate: the real tree PASSES its own proofs (and the
+    # lint-clean CI gate in test_lint.py keeps every other checker green).
+    diags = lint_paths(
+        [os.path.join(REPO, "dsort_tpu")],
+        load_config(REPO),
+        checkers=[SpmdChecker(), CapsChecker()],
+    )
+    assert diags == []
+
+
+# -- differential: restricted evaluator vs the imported functions ------------
+
+
+def test_symeval_matches_real_functions():
+    from dsort_tpu.parallel import exchange as real
+
+    with open(
+        os.path.join(REPO, "dsort_tpu", "parallel", "exchange.py"),
+        encoding="utf-8",
+    ) as f:
+        ev = Evaluator(extract_functions(ast.parse(f.read())))
+    for p in (1, 2, 3, 4, 6, 8):
+        for n in (8, 100, 4096):
+            assert ev.call("ring_step_quantum", [n, p]) == (
+                real.ring_step_quantum(n, p)
+            )
+            for m in (0, 1, n // 2, n):
+                assert ev.call("_quantize_cap", [m, n, p]) == (
+                    real._quantize_cap(m, n, p)
+                )
+        for k in range(p):
+            assert ev.call("_ring_perm", [p, k]) == real._ring_perm(p, k)
+    assert ev.call("ladder_rungs", [4096]) == real.ladder_rungs(4096)
+    assert ev.call("parity_slots", [3]) == real.parity_slots(3)
+
+
+# -- engine satellites -------------------------------------------------------
+
+
+def test_cache_key_tracks_spmd_required_sources(tmp_path):
+    _copy_tree(tmp_path)
+    cfg = load_config(str(tmp_path))
+    checkers = all_checkers()
+    k1 = ResultCache._config_key(cfg, checkers)
+    ex = tmp_path / "dsort_tpu" / "parallel" / "exchange.py"
+    ex.write_text(ex.read_text() + "\n# cap-ladder tweak\n")
+    k2 = ResultCache._config_key(cfg, checkers)
+    assert k1 != k2, "editing a required SPMD source must invalidate cache"
+    # A file the registry does NOT require never participates in the key.
+    (tmp_path / "dsort_tpu" / "other.py").write_text("X = 1\n")
+    assert ResultCache._config_key(cfg, checkers) == k2
+
+
+def test_sarif_round_trip():
+    diags = run_fixture("bad_spmd.py")
+    assert diags  # a round-trip over nothing proves nothing
+    doc = json.loads(format_sarif(diags))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    # The full catalog ships as driver rules, findings or not.
+    assert {
+        "DS1200", "DS1201", "DS1202", "DS1203", "DS1204",
+        "DS1300", "DS1301", "DS1302", "DS1303",
+    } <= rules
+    got = {
+        (
+            r["ruleId"],
+            r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+            r["locations"][0]["physicalLocation"]["region"]["startLine"],
+            r["locations"][0]["physicalLocation"]["region"]["startColumn"] - 1,
+            r["message"]["text"],
+            r["level"],
+        )
+        for r in run["results"]
+    }
+    want = {
+        (d.code, d.path, d.line, d.col, d.message, d.severity) for d in diags
+    }
+    assert got == want
+
+
+def test_stats_accounting():
+    stats = LintStats()
+    lint_paths(
+        [fixture("bad_spmd.py")],
+        LintConfig(root=REPO),
+        checkers=[SpmdChecker(scope=("*",)), CapsChecker(scope=("*",))],
+        stats=stats,
+    )
+    assert stats.files == 1 and stats.cached == 0
+    row = stats.checkers["spmd"]
+    assert row["findings"] == 8
+    assert row["files"] == 1
+    assert row["seconds"] >= 0.0
+    assert not row["project"]
+    table = stats.format()
+    assert "spmd" in table and "caps" in table and "checker" in table
+
+
+def test_warm_cached_whole_tree_lint_is_fast(tmp_path):
+    # The interactivity pin: a warm cached `make lint` must stay in
+    # interactive territory (cold measured ~6s, warm ~1.5s in-process; the
+    # bound leaves CI headroom without letting the cache silently rot).
+    cfg = load_config(REPO)
+    cache = str(tmp_path / "lint-cache.json")
+    paths = [os.path.join(REPO, "dsort_tpu")]
+    lint_paths(paths, cfg, cache_path=cache)  # cold: populate
+    stats = LintStats()
+    t0 = time.perf_counter()
+    diags = lint_paths(paths, cfg, cache_path=cache, stats=stats)
+    warm = time.perf_counter() - t0
+    assert diags == []
+    assert stats.files > 0 and stats.cached == stats.files
+    assert warm < 4.0, f"warm cached lint took {warm:.2f}s"
